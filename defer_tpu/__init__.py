@@ -1,0 +1,41 @@
+"""defer_tpu — a TPU-native pipeline-parallel DNN inference framework.
+
+Built from scratch in JAX/XLA with the capabilities of DEFER
+(arXiv 2201.06769; reference impl at /root/reference). The reference's
+dispatcher/compute-node/TCP-socket architecture (reference
+src/dispatcher.py, src/node.py, src/node_state.py) is replaced by a
+single-controller JAX program: a model is partitioned at named cut-points
+into jit-compiled stages, each pinned to one TPU core, and activations
+flow core-to-core over ICI instead of ZFP+LZ4-compressed sockets.
+
+Public API (mirrors the reference's user model, reference src/test.py:21,47):
+
+    from defer_tpu import DEFER
+    defer = DEFER()                       # discovers the TPU mesh
+    defer.run_defer(model, ["add_8"], input_q, output_q)
+"""
+
+from defer_tpu.api import DEFER, run_local_inference
+from defer_tpu.config import DeferConfig
+from defer_tpu.graph.ir import Graph, GraphBuilder, OpNode
+from defer_tpu.graph.partition import (
+    PartitionError,
+    partition,
+    stage_params,
+    validate_cut_points,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "DEFER",
+    "DeferConfig",
+    "Graph",
+    "GraphBuilder",
+    "OpNode",
+    "PartitionError",
+    "partition",
+    "run_local_inference",
+    "stage_params",
+    "validate_cut_points",
+]

@@ -1,0 +1,221 @@
+"""The DEFER facade — the reference's user-facing API, TPU-native.
+
+Reference usage (src/test.py:20-21,44-50):
+
+    defer = DEFER(['192.168.31.225', '192.168.31.215'])
+    defer.run_defer(model, ["add_8"], input_q, output_q)   # in a thread
+
+Here:
+
+    defer = DEFER()                          # TPU mesh auto-discovered
+    defer.run_defer(model, ["add_8"], input_q, output_q)
+
+`run_defer` keeps the reference's blocking, queue-driven contract
+(reference src/dispatcher.py:120-129) so driver scripts port unchanged,
+but "dispatch" is partition + per-core jit compile + parameter placement
+instead of sockets, and the stream loop is the async pipeline.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+import time
+from typing import Any, Sequence
+
+import jax
+
+from defer_tpu.config import DeferConfig, normalize_cuts
+from defer_tpu.graph.ir import Graph, GraphParams
+from defer_tpu.graph.partition import partition
+from defer_tpu.models import Model
+from defer_tpu.parallel.mesh import pipeline_devices
+from defer_tpu.parallel.pipeline import Pipeline
+from defer_tpu.runtime.host_io import STOP, ProgressMonitor
+from defer_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class DEFER:
+    """Pipeline-parallel inference orchestrator.
+
+    Replaces the reference's dispatcher (reference src/dispatcher.py:22):
+    instead of an IP list it takes an optional explicit device list
+    (default: every device JAX can see — the TPU slice).
+    """
+
+    def __init__(
+        self,
+        devices: Sequence[jax.Device] | None = None,
+        config: DeferConfig | None = None,
+    ):
+        self.devices = list(devices) if devices is not None else None
+        self.config = config or DeferConfig()
+        self._stop = threading.Event()
+        self.last_pipeline: Pipeline | None = None
+        # Filled by run_defer when config.probe_every > 0.
+        self.last_stage_latencies: list[dict[str, float]] | None = None
+
+    # -- construction ----------------------------------------------------
+
+    def build_pipeline(
+        self,
+        model: Model | Graph,
+        partition_layers: Sequence[str] | str | None,
+        *,
+        params: GraphParams | None = None,
+        rng: jax.Array | None = None,
+        batch_size: int = 1,
+    ) -> tuple[Pipeline, Any]:
+        """Partition + compile; returns (pipeline, example_input).
+
+        The analogue of `_partition` + `_dispatchModels` (reference
+        src/dispatcher.py:30-73): cut points become stage graphs, weight
+        shipping becomes `device_put` of each stage's param slice.
+        """
+        cuts = normalize_cuts(partition_layers)
+        if isinstance(model, Model):
+            graph = model.graph
+            example = model.example_input(batch_size)
+        else:
+            graph = model
+            example = None
+        if params is None:
+            if not isinstance(model, Model):
+                raise ValueError("params required when passing a raw Graph")
+            params = model.init(
+                rng if rng is not None else jax.random.key(0),
+                batch_size=batch_size,
+                param_dtype=self.config.param_dtype,
+            )
+        stages = partition(graph, cuts) if cuts else [graph]
+        devices = pipeline_devices(len(stages), self.devices)
+        log.info(
+            "built %d stages over devices %s", len(stages), devices
+        )
+        pipe = Pipeline(stages, params, devices, self.config)
+        self.last_pipeline = pipe
+        return pipe, example
+
+    # -- streaming (the reference's run_defer contract) ------------------
+
+    def run_defer(
+        self,
+        model: Model | Graph,
+        partition_layers: Sequence[str] | str | None,
+        input_stream: "queue.Queue[Any]",
+        output_stream: "queue.Queue[Any]",
+        *,
+        params: GraphParams | None = None,
+        rng: jax.Array | None = None,
+    ) -> None:
+        """Blocking stream loop: consume input_stream, produce
+        output_stream. Ends on a None/STOP sentinel or `stop()`.
+
+        Signature mirrors reference src/dispatcher.py:120.
+        """
+        self._stop.clear()
+        pipe, _ = self.build_pipeline(
+            model, partition_layers, params=params, rng=rng
+        )
+        monitor = ProgressMonitor(self.config.collective_timeout_s)
+        pending: "collections.deque[Any]" = collections.deque()
+        depth = self.config.max_inflight
+        since_probe = 0
+
+        def wait_ready(arr: Any) -> None:
+            # Poll instead of a bare block_until_ready so the watchdog
+            # can fire even while we're waiting on a stuck stage.
+            while not arr.is_ready():
+                monitor.check()
+                time.sleep(0.02)
+
+        def drain(block: bool) -> None:
+            while pending and (
+                block or len(pending) >= depth or pending[0].is_ready()
+            ):
+                wait_ready(pending[0])
+                out = pending.popleft()
+                monitor.completed()
+                output_stream.put(out)
+
+        # Unlike Pipeline.stream (pull-based), this loop must keep
+        # emitting results while the input queue idles — the reference's
+        # feed and result paths are independent threads for the same
+        # reason (src/dispatcher.py:93-118).
+        while not self._stop.is_set():
+            try:
+                item = input_stream.get(timeout=0.05)
+            except queue.Empty:
+                drain(block=False)
+                monitor.check()
+                continue
+            if item is None or item is STOP:
+                break
+            monitor.submitted()
+            pending.append(pipe(item))
+            drain(block=False)
+            monitor.check()
+            since_probe += 1
+            if (
+                self.config.probe_every
+                and since_probe >= self.config.probe_every
+            ):
+                # Synchronous per-stage latency probe; drain first so it
+                # doesn't interleave with (and distort) in-flight work.
+                since_probe = 0
+                drain(block=True)
+                self.last_stage_latencies = pipe.probe_stage_latencies(
+                    item, iters=3
+                )
+        drain(block=True)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def run_local_inference(
+    model: Model,
+    *,
+    batch_size: int = 1,
+    duration_s: float = 10.0,
+    params: GraphParams | None = None,
+    compute_dtype: Any = None,
+) -> dict[str, float]:
+    """Single-device baseline: jit the whole model on one core and loop.
+
+    The analogue of the reference's `local_infer.py` (reference
+    src/local_infer.py:16-23: loop `model.predict` for 10 min, count
+    results) — this defines the denominator of every speedup claim.
+    """
+    cfg = DeferConfig()
+    if compute_dtype is not None:
+        cfg = cfg.replace(compute_dtype=compute_dtype)
+    if params is None:
+        params = model.init(jax.random.key(0), batch_size=batch_size)
+    x = model.example_input(batch_size)
+
+    fn = jax.jit(
+        lambda p, v: model.graph.apply(p, v.astype(cfg.compute_dtype))
+    )
+    fn(params, x).block_until_ready()  # compile
+
+    count = 0
+    t0 = time.perf_counter()
+    pending = []
+    while time.perf_counter() - t0 < duration_s:
+        pending.append(fn(params, x))
+        count += 1
+        if len(pending) >= 16:
+            pending.pop(0).block_until_ready()
+    for out in pending:
+        out.block_until_ready()
+    dt = time.perf_counter() - t0
+    return {
+        "count": count,
+        "seconds": dt,
+        "batches_per_sec": count / dt,
+        "items_per_sec": count * batch_size / dt,
+    }

@@ -1,0 +1,55 @@
+"""Configuration for defer_tpu.
+
+The reference hard-codes every knob (dispatcher IP at reference
+src/dispatcher.py:25, node IPs at src/test.py:20, ports at src/node.py:18,
+chunk size at src/dispatcher.py:26, queue sizes at src/test.py:44-45).
+Here everything is an explicit dataclass; topology comes from the JAX
+runtime rather than IP lists.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class DeferConfig:
+    """All knobs for a pipelined inference run.
+
+    Attributes:
+      compute_dtype: dtype activations/params are cast to for compute.
+        bfloat16 keeps matmuls/convs on the MXU at full rate.
+      param_dtype: dtype parameters are stored in.
+      max_inflight: microbatches allowed in flight before the host blocks
+        on the oldest result — the backpressure analogue of the
+        reference's bounded queues (reference src/test.py:44,
+        src/node.py:139).
+      probe_every: during run_defer, measure per-stage latency
+        (synchronously, draining first) every N microbatches and stash
+        it on DEFER.last_stage_latencies; 0 disables probing.
+      donate_activations: donate inter-stage activation buffers to XLA.
+      collective_timeout_s: watchdog timeout for a stage/transfer that
+        never completes (the reference has no failure detection at all;
+        a dead node hangs it forever — reference src/node.py:30-31).
+    """
+
+    compute_dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    max_inflight: int = 32
+    probe_every: int = 0
+    donate_activations: bool = True
+    collective_timeout_s: float = 120.0
+
+    def replace(self, **kw: Any) -> "DeferConfig":
+        return dataclasses.replace(self, **kw)
+
+
+def normalize_cuts(cuts: Sequence[str] | str | None) -> tuple[str, ...]:
+    if cuts is None:
+        return ()
+    if isinstance(cuts, str):
+        return (cuts,)
+    return tuple(cuts)

@@ -1,0 +1,17 @@
+from defer_tpu.graph.ir import Graph, GraphBuilder, OpNode
+from defer_tpu.graph.partition import (
+    PartitionError,
+    partition,
+    stage_params,
+    validate_cut_points,
+)
+
+__all__ = [
+    "Graph",
+    "GraphBuilder",
+    "OpNode",
+    "PartitionError",
+    "partition",
+    "stage_params",
+    "validate_cut_points",
+]

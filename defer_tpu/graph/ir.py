@@ -1,0 +1,287 @@
+"""Framework-neutral DAG IR for models.
+
+The reference has no IR: it partitions live Keras objects by recursively
+re-calling layers (reference src/dag_util.py:11-27), which re-executes any
+layer reachable along multiple paths (no memoization — reference
+src/dag_util.py:18-19) and cannot validate cut-points. Here a model is an
+explicit DAG of named ops; execution walks the topological order once with
+a value cache, so multi-branch models (ResNet adds, Inception concats)
+cost each op exactly once, and partitioning is ordinary graph surgery.
+
+Shapes/dtypes are static and inferred from the op `apply` functions via
+``jax.eval_shape`` — exactly the property XLA needs to tile convs/matmuls
+onto the MXU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+
+# Params for one node: dict of named arrays (possibly empty).
+NodeParams = Mapping[str, jax.Array]
+# Params for a graph: node name -> NodeParams. An ordinary pytree, so it
+# slices cleanly per stage and works with jit/device_put/shard_map.
+GraphParams = Mapping[str, NodeParams]
+
+INPUT_OP = "input"
+
+
+@dataclasses.dataclass(frozen=True)
+class OpNode:
+    """One named op in the DAG.
+
+    Attributes:
+      name: unique node name (the analogue of a Keras layer name; cut
+        points are specified by these names, as in reference
+        src/test.py:28).
+      op: op kind, resolved against the op registry (defer_tpu.ops).
+      inputs: names of producer nodes, in argument order.
+      attrs: static attributes (strides, padding, ...). Must be hashable
+        values only; they are baked into the jitted program.
+    """
+
+    name: str
+    op: str
+    inputs: tuple[str, ...]
+    attrs: Mapping[str, Any] = dataclasses.field(default_factory=dict)
+
+
+class GraphError(ValueError):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class Graph:
+    """A single-input single-output DAG of ops in topological order.
+
+    Same model class the reference supports: its partitioner assumes one
+    input and one output tensor (reference src/dag_util.py:29-33).
+    """
+
+    name: str
+    nodes: tuple[OpNode, ...]
+    input_name: str
+    output_name: str
+
+    def __post_init__(self) -> None:
+        seen: set[str] = set()
+        for node in self.nodes:
+            if node.name in seen:
+                raise GraphError(f"duplicate node name {node.name!r}")
+            for inp in node.inputs:
+                if inp not in seen:
+                    raise GraphError(
+                        f"node {node.name!r} consumes {inp!r} before it is "
+                        "defined — nodes must be topologically ordered"
+                    )
+            seen.add(node.name)
+        if self.input_name not in seen:
+            raise GraphError(f"input node {self.input_name!r} not in graph")
+        if self.output_name not in seen:
+            raise GraphError(f"output node {self.output_name!r} not in graph")
+
+    # -- lookups ---------------------------------------------------------
+
+    @property
+    def node_map(self) -> dict[str, OpNode]:
+        return {n.name: n for n in self.nodes}
+
+    def __contains__(self, name: str) -> bool:
+        return any(n.name == name for n in self.nodes)
+
+    def consumers(self) -> dict[str, list[str]]:
+        out: dict[str, list[str]] = {n.name: [] for n in self.nodes}
+        for n in self.nodes:
+            for inp in n.inputs:
+                out[inp].append(n.name)
+        return out
+
+    def ancestors(self, name: str) -> set[str]:
+        """All nodes from which `name` is reachable, inclusive."""
+        node_map = self.node_map
+        if name not in node_map:
+            raise GraphError(f"no node named {name!r} in graph {self.name!r}")
+        result: set[str] = set()
+        stack = [name]
+        while stack:
+            cur = stack.pop()
+            if cur in result:
+                continue
+            result.add(cur)
+            stack.extend(node_map[cur].inputs)
+        return result
+
+    # -- init / apply ----------------------------------------------------
+
+    def init(
+        self,
+        rng: jax.Array,
+        input_shape: Sequence[int],
+        *,
+        param_dtype: Any = jnp.float32,
+        compute_dtype: Any = jnp.float32,
+    ) -> GraphParams:
+        """Initialize parameters for every node.
+
+        Output shapes are derived from each op's `apply` via
+        ``jax.eval_shape`` so there is exactly one source of shape truth.
+        """
+        from defer_tpu.ops import get_op
+
+        shapes: dict[str, tuple[int, ...]] = {}
+        dtypes: dict[str, Any] = {}
+        params: dict[str, dict[str, jax.Array]] = {}
+        for node in self.nodes:
+            if node.op == INPUT_OP:
+                shapes[node.name] = tuple(input_shape)
+                dtypes[node.name] = compute_dtype
+                params[node.name] = {}
+                continue
+            op = get_op(node.op)
+            in_shapes = [shapes[i] for i in node.inputs]
+            rng, sub = jax.random.split(rng)
+            node_params = op.init(sub, node.attrs, in_shapes, param_dtype)
+            params[node.name] = node_params
+            out = jax.eval_shape(
+                lambda p, xs, _op=op, _attrs=node.attrs: _op.apply(p, xs, _attrs),
+                node_params,
+                [
+                    jax.ShapeDtypeStruct(shapes[i], dtypes[i])
+                    for i in node.inputs
+                ],
+            )
+            shapes[node.name] = tuple(out.shape)
+            dtypes[node.name] = out.dtype
+        return params
+
+    def infer_shapes(
+        self,
+        params: GraphParams,
+        input_shape: Sequence[int],
+        dtype: Any = jnp.float32,
+    ) -> dict[str, jax.ShapeDtypeStruct]:
+        """Shape/dtype of every node's output for a given input spec."""
+        from defer_tpu.ops import get_op
+
+        specs: dict[str, jax.ShapeDtypeStruct] = {}
+        for node in self.nodes:
+            if node.op == INPUT_OP:
+                specs[node.name] = jax.ShapeDtypeStruct(
+                    tuple(input_shape), dtype
+                )
+                continue
+            op = get_op(node.op)
+            specs[node.name] = jax.eval_shape(
+                lambda p, xs, _op=op, _attrs=node.attrs: _op.apply(
+                    p, xs, _attrs
+                ),
+                params.get(node.name, {}),
+                [specs[i] for i in node.inputs],
+            )
+        return specs
+
+    def apply(self, params: GraphParams, x: jax.Array) -> jax.Array:
+        """Run the graph. Single topological pass with a value cache —
+        the memoized fix for the reference's exponential re-traversal of
+        multi-path DAGs (reference src/dag_util.py:18-19)."""
+        from defer_tpu.ops import get_op
+
+        cache: dict[str, jax.Array] = {}
+        consumers_left = {
+            name: len(cons) for name, cons in self.consumers().items()
+        }
+        consumers_left[self.output_name] += 1  # never evict the output
+        for node in self.nodes:
+            if node.op == INPUT_OP:
+                cache[node.name] = x
+            else:
+                op = get_op(node.op)
+                inputs = [cache[i] for i in node.inputs]
+                cache[node.name] = op.apply(
+                    params.get(node.name, {}), inputs, node.attrs
+                )
+                # Free dead values eagerly so tracing giant graphs
+                # (NASNet) doesn't hold every intermediate alive.
+                for i in node.inputs:
+                    consumers_left[i] -= 1
+                    if consumers_left[i] == 0:
+                        del cache[i]
+        return cache[self.output_name]
+
+    def output_spec(
+        self,
+        params: GraphParams,
+        input_shape: Sequence[int],
+        dtype: Any = jnp.float32,
+    ) -> jax.ShapeDtypeStruct:
+        return jax.eval_shape(
+            self.apply, params, jax.ShapeDtypeStruct(tuple(input_shape), dtype)
+        )
+
+    def param_count(self, params: GraphParams) -> int:
+        return sum(
+            leaf.size for leaf in jax.tree_util.tree_leaves(params)
+        )
+
+
+class GraphBuilder:
+    """Imperative builder producing an immutable `Graph`.
+
+    Auto-names nodes per op kind (conv, conv_1, conv_2, ...) unless an
+    explicit name is given — mirroring Keras naming so reference-style
+    cut lists like ["add_2", "add_4", ...] (reference src/test.py:27)
+    carry over unchanged.
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        self._nodes: list[OpNode] = []
+        self._names: set[str] = set()
+        self._counters: dict[str, int] = {}
+        self._input_name: str | None = None
+
+    def _fresh(self, op: str) -> str:
+        n = self._counters.get(op, 0)
+        self._counters[op] = n + 1
+        return op if n == 0 else f"{op}_{n}"
+
+    def input(self, name: str = "input") -> str:
+        if self._input_name is not None:
+            raise GraphError("graph already has an input node")
+        self._input_name = name
+        return self.add(INPUT_OP, name=name)
+
+    def add(
+        self,
+        op: str,
+        *inputs: str,
+        name: str | None = None,
+        **attrs: Any,
+    ) -> str:
+        if name is None:
+            name = self._fresh(op)
+        if name in self._names:
+            raise GraphError(f"duplicate node name {name!r}")
+        for inp in inputs:
+            if inp not in self._names:
+                raise GraphError(
+                    f"node {name!r}: unknown input {inp!r} (must be added "
+                    "before use)"
+                )
+        self._names.add(name)
+        self._nodes.append(OpNode(name, op, tuple(inputs), dict(attrs)))
+        return name
+
+    def build(self, output: str) -> Graph:
+        if self._input_name is None:
+            raise GraphError("graph has no input node")
+        return Graph(
+            name=self.name,
+            nodes=tuple(self._nodes),
+            input_name=self._input_name,
+            output_name=output,
+        )

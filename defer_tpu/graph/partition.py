@@ -1,0 +1,128 @@
+"""Cut-point partitioner: Graph -> chain of stage Graphs.
+
+The reference's partitioner (reference src/dispatcher.py:30-45 driving
+src/dag_util.py:29-33) rebuilds Keras sub-models by recursive backward
+traversal. It has two defects this module fixes by construction:
+
+  1. No cut validation — a cut through the middle of a residual branch
+    silently miscompiles (reference src/dag_util.py has no check; see
+    the warning comment at reference src/test.py:24-28).
+    `validate_cut_points` proves each cut is a single-tensor articulation
+    point: every edge crossing the cut boundary originates at the cut
+    node itself.
+  2. No memoization — layers reachable along multiple paths are re-called
+    once per path (reference src/dag_util.py:18-19). Here stages are
+    induced subgraphs; each op appears in exactly one stage, once.
+
+A graph cut at [c1, ..., cN] yields N+1 stages (reference
+src/dispatcher.py:33 loops len(cuts)+1 times the same way).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from defer_tpu.graph.ir import INPUT_OP, Graph, GraphParams, OpNode
+
+
+class PartitionError(ValueError):
+    pass
+
+
+def validate_cut_points(graph: Graph, cuts: Sequence[str]) -> None:
+    """Raise PartitionError unless every cut is a valid chain boundary.
+
+    A cut node c is valid iff every edge (u -> v) with u on c's ancestor
+    side and v on the other side has u == c; then the only tensor
+    crossing the boundary is c's output, which is what the pipeline
+    relays to the next stage (the analogue of the single activation the
+    reference ships per hop, reference src/node.py:125-133).
+    """
+    node_map = graph.node_map
+    seen: set[str] = set()
+    prev_ancestors: set[str] = set()
+    for cut in cuts:
+        if cut not in node_map:
+            raise PartitionError(
+                f"cut point {cut!r} is not a node of graph {graph.name!r}"
+            )
+        if cut in seen:
+            raise PartitionError(f"duplicate cut point {cut!r}")
+        seen.add(cut)
+        if cut in (graph.input_name, graph.output_name):
+            raise PartitionError(
+                f"cut point {cut!r} cannot be the graph input/output"
+            )
+        anc = graph.ancestors(cut)
+        if not prev_ancestors <= anc:
+            raise PartitionError(
+                f"cut points must be in topological chain order; {cut!r} "
+                "does not dominate the previous cut"
+            )
+        for node in graph.nodes:
+            if node.name in anc:
+                continue
+            for inp in node.inputs:
+                if inp in anc and inp != cut:
+                    raise PartitionError(
+                        f"invalid cut at {cut!r}: edge {inp!r} -> "
+                        f"{node.name!r} crosses the boundary, so the cut is "
+                        "not a single-tensor articulation point (e.g. a cut "
+                        "inside a residual branch)"
+                    )
+        prev_ancestors = anc
+
+
+def partition(graph: Graph, cuts: Sequence[str]) -> list[Graph]:
+    """Split `graph` at `cuts` into a chain of stage graphs.
+
+    Stage i's input node keeps the *cut node's name* (op rewritten to
+    "input"), so parameters keep their global node-name keys and
+    `stage_params` is a plain dict slice.
+    """
+    cuts = list(cuts)
+    validate_cut_points(graph, cuts)
+
+    boundaries = [graph.input_name, *cuts]
+    segment_of: dict[str, int] = {}
+    prev_anc: set[str] = set()
+    for i, cut in enumerate(cuts):
+        anc = graph.ancestors(cut)
+        for name in anc - prev_anc:
+            segment_of[name] = i
+        prev_anc = anc
+    for node in graph.nodes:
+        if node.name not in segment_of:
+            segment_of[node.name] = len(cuts)
+
+    stages: list[Graph] = []
+    for i in range(len(cuts) + 1):
+        entry = boundaries[i]
+        nodes: list[OpNode] = []
+        for node in graph.nodes:
+            if segment_of[node.name] != i:
+                continue
+            if node.name == entry:
+                nodes.append(OpNode(entry, INPUT_OP, ()))
+            else:
+                nodes.append(node)
+        if i > 0 and not any(n.name == entry for n in nodes):
+            # The cut node was assigned to segment i-1 (it is its own
+            # ancestor); stage i still needs it as its input placeholder.
+            nodes.insert(0, OpNode(entry, INPUT_OP, ()))
+        out = cuts[i] if i < len(cuts) else graph.output_name
+        stages.append(
+            Graph(
+                name=f"{graph.name}.stage{i}",
+                nodes=tuple(nodes),
+                input_name=entry,
+                output_name=out,
+            )
+        )
+    return stages
+
+
+def stage_params(params: GraphParams, stage: Graph) -> dict:
+    """Slice the full parameter pytree down to one stage's nodes."""
+    names = {n.name for n in stage.nodes}
+    return {k: v for k, v in params.items() if k in names and v}

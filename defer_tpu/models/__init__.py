@@ -1,0 +1,130 @@
+"""Model zoo: the BASELINE.json config list, built on the graph IR.
+
+The reference pulls its zoo from `tf.keras.applications` (only ResNet50
+is exercised in-repo, reference src/test.py:23, src/local_infer.py:8).
+Here each model is built natively as an IR graph with Keras-compatible
+node names, so reference-style cut lists ("add_2", "add_4", ...,
+reference src/test.py:27) apply unchanged.
+
+Registry:
+    model = get_model("resnet50")        # -> Model(graph, input_shape, ...)
+    params = model.init(jax.random.key(0))
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from defer_tpu.graph.ir import Graph, GraphParams
+
+
+@dataclasses.dataclass(frozen=True)
+class Model:
+    """A zoo model: IR graph + input spec + recommended cut points.
+
+    `default_cuts(n)` returns n-1 cut points giving n roughly balanced
+    stages — the analogue of the documented cut list the reference makes
+    the user pick by hand (reference src/test.py:24-28).
+    """
+
+    name: str
+    graph: Graph
+    input_shape: tuple[int, ...]  # without batch dim
+    input_dtype: Any = jnp.float32
+    cut_candidates: tuple[str, ...] = ()
+
+    def init(
+        self,
+        rng: jax.Array,
+        *,
+        batch_size: int = 1,
+        param_dtype: Any = jnp.float32,
+        compute_dtype: Any = jnp.float32,
+    ) -> GraphParams:
+        return self.graph.init(
+            rng,
+            (batch_size, *self.input_shape),
+            param_dtype=param_dtype,
+            compute_dtype=compute_dtype,
+        )
+
+    def example_input(
+        self, batch_size: int = 1, dtype: Any | None = None
+    ) -> jax.Array:
+        dtype = dtype or self.input_dtype
+        shape = (batch_size, *self.input_shape)
+        if jnp.issubdtype(dtype, jnp.integer):
+            return jnp.zeros(shape, dtype)
+        return jnp.ones(shape, dtype)
+
+    def default_cuts(self, num_stages: int) -> list[str]:
+        if num_stages < 1:
+            raise ValueError("num_stages must be >= 1")
+        if num_stages == 1:
+            return []
+        cands = self.cut_candidates
+        if num_stages - 1 > len(cands):
+            raise ValueError(
+                f"{self.name} has {len(cands)} candidate cut points; "
+                f"cannot make {num_stages} stages"
+            )
+        # Evenly spaced picks, kept strictly increasing so we always
+        # return exactly num_stages-1 distinct cuts.
+        picks: list[int] = []
+        prev = -1
+        remaining = num_stages - 1
+        for i in range(num_stages - 1):
+            j = round((i + 1) * len(cands) / num_stages) - 1
+            j = max(j, prev + 1)
+            j = min(j, len(cands) - (remaining - i))
+            picks.append(j)
+            prev = j
+        return [cands[j] for j in picks]
+
+
+_BUILDERS: dict[str, Callable[..., Model]] = {}
+
+
+def register_model(name: str) -> Callable:
+    def deco(fn: Callable[..., Model]) -> Callable[..., Model]:
+        _BUILDERS[name] = fn
+        return fn
+
+    return deco
+
+
+def _load_zoo() -> None:
+    """Import every zoo module for its register_model side effects."""
+    import importlib
+
+    for mod in (
+        "bert",
+        "efficientnet",
+        "inception",
+        "inception_resnet",
+        "mobilenet",
+        "nasnet",
+        "resnet",
+        "vgg",
+    ):
+        importlib.import_module(f"defer_tpu.models.{mod}")
+
+
+def get_model(name: str, **kwargs: Any) -> Model:
+    _load_zoo()
+    try:
+        builder = _BUILDERS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown model {name!r}; available: {sorted(_BUILDERS)}"
+        ) from None
+    return builder(**kwargs)
+
+
+def model_names() -> list[str]:
+    _load_zoo()
+    return sorted(_BUILDERS)

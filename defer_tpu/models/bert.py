@@ -1,0 +1,1 @@
+"""bert — implemented in a later milestone this round."""

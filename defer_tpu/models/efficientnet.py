@@ -1,0 +1,1 @@
+"""efficientnet — implemented in a later milestone this round."""

@@ -1,0 +1,1 @@
+"""inception — implemented in a later milestone this round."""

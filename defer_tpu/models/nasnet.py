@@ -1,0 +1,1 @@
+"""nasnet — implemented in a later milestone this round."""

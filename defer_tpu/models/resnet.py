@@ -1,0 +1,143 @@
+"""ResNet v1 (50/101/152) in the graph IR — the reference's headline model.
+
+The reference benchmarks exactly this network: `ResNet50(weights=
+'imagenet')` cut at `add_N` layers (reference src/test.py:23-28,
+src/local_infer.py:8). Residual-sum nodes are named `add_1` ... `add_16`
+to match the TF1-era Keras auto-naming the reference's cut lists use, so
+`part_at = ["add_2", "add_4", ..., "add_14"]` (reference src/test.py:27)
+works verbatim. Every add output dominates the downstream graph, making
+each a valid single-tensor cut point (SURVEY.md §3.4).
+"""
+
+from __future__ import annotations
+
+from defer_tpu.graph.ir import GraphBuilder
+from defer_tpu.models import Model, register_model
+
+
+def _conv_bn_relu(
+    b: GraphBuilder,
+    x: str,
+    features: int,
+    kernel: int,
+    *,
+    strides: int = 1,
+    padding: str = "SAME",
+    relu: bool = True,
+    prefix: str,
+) -> str:
+    x = b.add(
+        "conv",
+        x,
+        name=f"{prefix}_conv",
+        features=features,
+        kernel_size=kernel,
+        strides=strides,
+        padding=padding,
+        use_bias=False,
+    )
+    x = b.add("batch_norm", x, name=f"{prefix}_bn", eps=1.001e-5)
+    if relu:
+        x = b.add("relu", x, name=f"{prefix}_relu")
+    return x
+
+
+def _bottleneck(
+    b: GraphBuilder,
+    x: str,
+    filters: int,
+    *,
+    strides: int,
+    projection: bool,
+    prefix: str,
+    add_name: str,
+) -> str:
+    """Standard v1 bottleneck: 1x1 -> 3x3 -> 1x1(4f) + shortcut."""
+    shortcut = x
+    if projection:
+        shortcut = b.add(
+            "conv",
+            x,
+            name=f"{prefix}_proj_conv",
+            features=filters * 4,
+            kernel_size=1,
+            strides=strides,
+            padding="VALID",
+            use_bias=False,
+        )
+        shortcut = b.add(
+            "batch_norm", shortcut, name=f"{prefix}_proj_bn", eps=1.001e-5
+        )
+    y = _conv_bn_relu(
+        b, x, filters, 1, strides=strides, padding="VALID", prefix=f"{prefix}_a"
+    )
+    y = _conv_bn_relu(b, y, filters, 3, prefix=f"{prefix}_b")
+    y = _conv_bn_relu(
+        b, y, filters * 4, 1, padding="VALID", relu=False, prefix=f"{prefix}_c"
+    )
+    out = b.add("add", y, shortcut, name=add_name)
+    return b.add("relu", out, name=f"{add_name}_relu")
+
+
+def _build_resnet(
+    name: str, blocks_per_group: tuple[int, ...], num_classes: int = 1000
+) -> Model:
+    b = GraphBuilder(name)
+    x = b.input("input")
+    x = b.add("zero_pad", x, name="conv1_pad", padding=((3, 3), (3, 3)))
+    x = _conv_bn_relu(
+        b, x, 64, 7, strides=2, padding="VALID", prefix="conv1"
+    )
+    x = b.add("zero_pad", x, name="pool1_pad", padding=((1, 1), (1, 1)))
+    x = b.add(
+        "max_pool", x, name="pool1", window=3, strides=2, padding="VALID"
+    )
+
+    adds: list[str] = []
+    add_idx = 1
+    filters = 64
+    for group, num_blocks in enumerate(blocks_per_group, start=2):
+        for block in range(num_blocks):
+            first = block == 0
+            x = _bottleneck(
+                b,
+                x,
+                filters,
+                # Group 2 keeps stride 1 (the stem's maxpool already
+                # downsampled); later groups downsample in their first block.
+                strides=2 if (first and group > 2) else 1,
+                projection=first,
+                prefix=f"res{group}{chr(ord('a') + block)}",
+                add_name=f"add_{add_idx}",
+            )
+            adds.append(f"add_{add_idx}")
+            add_idx += 1
+        filters *= 2
+
+    x = b.add("global_avg_pool", x, name="avg_pool")
+    x = b.add("dense", x, name="fc", features=num_classes)
+    x = b.add("softmax", x, name="predictions")
+    graph = b.build(x)
+    # Cut at the post-add relu so the relu isn't duplicated across stages;
+    # `add_N` itself is also valid (it dominates everything downstream).
+    return Model(
+        name=name,
+        graph=graph,
+        input_shape=(224, 224, 3),
+        cut_candidates=tuple(adds),
+    )
+
+
+@register_model("resnet50")
+def resnet50(num_classes: int = 1000) -> Model:
+    return _build_resnet("resnet50", (3, 4, 6, 3), num_classes)
+
+
+@register_model("resnet101")
+def resnet101(num_classes: int = 1000) -> Model:
+    return _build_resnet("resnet101", (3, 4, 23, 3), num_classes)
+
+
+@register_model("resnet152")
+def resnet152(num_classes: int = 1000) -> Model:
+    return _build_resnet("resnet152", (3, 8, 36, 3), num_classes)

@@ -1,0 +1,14 @@
+"""Op registry for the graph IR.
+
+Every `OpNode.op` string resolves here. The reference delegated all
+compute to opaque Keras layer objects (reference src/dag_util.py:25-26,
+src/node.py:129); here each op is an explicit (init, apply) pair over
+plain JAX arrays, so stages jit-compile into single XLA programs that
+fuse onto the TPU's MXU/VPU.
+"""
+
+from defer_tpu.ops.registry import Op, get_op, op_names, register_op
+from defer_tpu.ops import library as _library  # registers the standard ops
+from defer_tpu.ops import transformer as _transformer  # transformer ops
+
+__all__ = ["Op", "get_op", "op_names", "register_op"]

@@ -1,0 +1,91 @@
+"""Attention: XLA reference implementation + Pallas flash-attention hook.
+
+`multi_head_attention` is the single entry point; the `mha` op and the
+SPMD transformer pipeline both route through it. On TPU it can dispatch
+to the Pallas flash kernel (defer_tpu/ops/pallas_attention.py); the XLA
+einsum path is the fallback and the numerical reference in tests.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def _split_heads(x: jax.Array, num_heads: int) -> jax.Array:
+    b, s, d = x.shape
+    return x.reshape(b, s, num_heads, d // num_heads).transpose(0, 2, 1, 3)
+
+
+def _merge_heads(x: jax.Array) -> jax.Array:
+    b, h, s, dh = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b, s, h * dh)
+
+
+def attention_reference(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    bias: jax.Array | None = None,
+    causal: bool = False,
+) -> jax.Array:
+    """Plain softmax attention on (B, H, S, Dh) tensors, fp32 softmax."""
+    dh = q.shape[-1]
+    logits = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * (dh**-0.5)
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    if causal:
+        s_q, s_k = logits.shape[-2:]
+        mask = jnp.tril(jnp.ones((s_q, s_k), jnp.bool_), k=s_k - s_q)
+        logits = jnp.where(mask, logits, -jnp.inf)
+    weights = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights, v)
+
+
+def _pallas_available() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def multi_head_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    num_heads: int,
+    bias: jax.Array | None = None,
+    causal: bool = False,
+    use_pallas: Any = "auto",
+) -> jax.Array:
+    """Attention on (B, S, D) projections; returns (B, S, D).
+
+    use_pallas: True / False / "auto" (pallas iff running on TPU and the
+    shape is tile-friendly).
+    """
+    qh, kh, vh = (_split_heads(t, num_heads) for t in (q, k, v))
+    want_pallas = (
+        use_pallas is True or (use_pallas == "auto" and _pallas_available())
+    )
+    if want_pallas and bias is None:
+        try:
+            from defer_tpu.ops.pallas_attention import flash_attention
+        except ImportError as e:
+            if use_pallas is True:
+                raise NotImplementedError(
+                    "use_pallas=True requested but the Pallas flash-"
+                    "attention kernel module is not available"
+                ) from e
+            flash_attention = None
+        if flash_attention is not None:
+            try:
+                return _merge_heads(flash_attention(qh, kh, vh, causal=causal))
+            except (NotImplementedError, ValueError):
+                if use_pallas is True:
+                    # An explicit request must not silently degrade.
+                    raise
+                # "auto": fall back to the XLA path.
+    return _merge_heads(attention_reference(qh, kh, vh, bias=bias, causal=causal))

@@ -1,0 +1,336 @@
+"""Standard CNN/elementwise op library.
+
+Layout is NHWC with HWIO conv kernels — the TPU-native layout (channels
+on the 128-wide lane dimension). All shape math lives in the `apply`
+functions; the IR derives shapes from them via `jax.eval_shape`
+(defer_tpu/graph/ir.py), so there is one source of truth.
+
+Covers every layer kind used by the reference's model zoo
+(BASELINE.json configs: ResNet50, VGG19, InceptionV3, MobileNetV2,
+EfficientNet-B0, InceptionResNetV2, NASNet) — conv/depthwise/dense/BN,
+poolings, pad/crop, add/mul/concat, and the activation set.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from defer_tpu.ops.registry import register_op
+
+
+def _pair(v: Any) -> tuple[int, int]:
+    if isinstance(v, (tuple, list)):
+        a, b = v
+        return (int(a), int(b))
+    return (int(v), int(v))
+
+
+def _conv_padding(
+    padding: Any, kernel: tuple[int, int], dilation: tuple[int, int]
+) -> Any:
+    """Resolve a padding attr to something lax.conv accepts."""
+    if isinstance(padding, str):
+        return padding.upper()
+    # explicit ((top, bottom), (left, right))
+    return tuple((int(a), int(b)) for a, b in padding)
+
+
+# --------------------------------------------------------------------------
+# conv / dense / batch norm
+# --------------------------------------------------------------------------
+
+
+def _conv_init(rng, attrs, in_shapes, param_dtype):
+    kh, kw = _pair(attrs.get("kernel_size", 3))
+    cin = in_shapes[0][-1]
+    groups = int(attrs.get("groups", 1))
+    cout = int(attrs["features"])
+    fan_in = kh * kw * (cin // groups)
+    k_key, _ = jax.random.split(rng)
+    kernel = jax.random.normal(
+        k_key, (kh, kw, cin // groups, cout), param_dtype
+    ) * jnp.sqrt(2.0 / fan_in).astype(param_dtype)
+    params = {"kernel": kernel}
+    if attrs.get("use_bias", False):
+        params["bias"] = jnp.zeros((cout,), param_dtype)
+    return params
+
+
+@register_op("conv", init=_conv_init)
+def conv_apply(params, inputs, attrs):
+    (x,) = inputs
+    strides = _pair(attrs.get("strides", 1))
+    dilation = _pair(attrs.get("dilation", 1))
+    kernel = params["kernel"].astype(x.dtype)
+    kh, kw = kernel.shape[0], kernel.shape[1]
+    out = lax.conv_general_dilated(
+        x,
+        kernel,
+        window_strides=strides,
+        padding=_conv_padding(attrs.get("padding", "SAME"), (kh, kw), dilation),
+        rhs_dilation=dilation,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=int(attrs.get("groups", 1)),
+    )
+    if "bias" in params:
+        out = out + params["bias"].astype(x.dtype)
+    return out
+
+
+def _depthwise_init(rng, attrs, in_shapes, param_dtype):
+    kh, kw = _pair(attrs.get("kernel_size", 3))
+    cin = in_shapes[0][-1]
+    mult = int(attrs.get("depth_multiplier", 1))
+    fan_in = kh * kw
+    kernel = jax.random.normal(
+        rng, (kh, kw, 1, cin * mult), param_dtype
+    ) * jnp.sqrt(2.0 / fan_in).astype(param_dtype)
+    params = {"kernel": kernel}
+    if attrs.get("use_bias", False):
+        params["bias"] = jnp.zeros((cin * mult,), param_dtype)
+    return params
+
+
+@register_op("depthwise_conv", init=_depthwise_init)
+def depthwise_conv_apply(params, inputs, attrs):
+    (x,) = inputs
+    strides = _pair(attrs.get("strides", 1))
+    dilation = _pair(attrs.get("dilation", 1))
+    kernel = params["kernel"].astype(x.dtype)
+    cin = x.shape[-1]
+    out = lax.conv_general_dilated(
+        x,
+        kernel,
+        window_strides=strides,
+        padding=_conv_padding(
+            attrs.get("padding", "SAME"), kernel.shape[:2], dilation
+        ),
+        rhs_dilation=dilation,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=cin,
+    )
+    if "bias" in params:
+        out = out + params["bias"].astype(x.dtype)
+    return out
+
+
+def _dense_init(rng, attrs, in_shapes, param_dtype):
+    cin = in_shapes[0][-1]
+    cout = int(attrs["features"])
+    kernel = jax.random.normal(rng, (cin, cout), param_dtype) * jnp.sqrt(
+        1.0 / cin
+    ).astype(param_dtype)
+    params = {"kernel": kernel}
+    if attrs.get("use_bias", True):
+        params["bias"] = jnp.zeros((cout,), param_dtype)
+    return params
+
+
+@register_op("dense", init=_dense_init)
+def dense_apply(params, inputs, attrs):
+    (x,) = inputs
+    out = x @ params["kernel"].astype(x.dtype)
+    if "bias" in params:
+        out = out + params["bias"].astype(x.dtype)
+    return out
+
+
+def _bn_init(rng, attrs, in_shapes, param_dtype):
+    del rng
+    c = in_shapes[0][-1]
+    return {
+        "scale": jnp.ones((c,), param_dtype),
+        "bias": jnp.zeros((c,), param_dtype),
+        "mean": jnp.zeros((c,), param_dtype),
+        "var": jnp.ones((c,), param_dtype),
+    }
+
+
+@register_op("batch_norm", init=_bn_init)
+def batch_norm_apply(params, inputs, attrs):
+    """Inference-mode BN: normalize with stored statistics."""
+    (x,) = inputs
+    eps = float(attrs.get("eps", 1e-3))
+    # Fold to a single multiply-add so XLA fuses it into the conv.
+    inv = lax.rsqrt(params["var"].astype(jnp.float32) + eps)
+    scale = (params["scale"].astype(jnp.float32) * inv).astype(x.dtype)
+    shift = (
+        params["bias"].astype(jnp.float32)
+        - params["mean"].astype(jnp.float32) * params["scale"].astype(jnp.float32) * inv
+    ).astype(x.dtype)
+    return x * scale + shift
+
+
+# --------------------------------------------------------------------------
+# pooling / padding / reshaping
+# --------------------------------------------------------------------------
+
+
+def _pool_dims(attrs):
+    wh, ww = _pair(attrs.get("window", 2))
+    sh, sw = _pair(attrs.get("strides", attrs.get("window", 2)))
+    padding = attrs.get("padding", "VALID")
+    if isinstance(padding, str):
+        padding = padding.upper()
+    else:
+        padding = ((0, 0), *[(int(a), int(b)) for a, b in padding], (0, 0))
+    return (wh, ww), (sh, sw), padding
+
+
+@register_op("max_pool")
+def max_pool_apply(params, inputs, attrs):
+    (x,) = inputs
+    (wh, ww), (sh, sw), padding = _pool_dims(attrs)
+    return lax.reduce_window(
+        x,
+        -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
+        lax.max,
+        (1, wh, ww, 1),
+        (1, sh, sw, 1),
+        padding,
+    )
+
+
+@register_op("avg_pool")
+def avg_pool_apply(params, inputs, attrs):
+    """Average pool that excludes padding from the count (TF semantics,
+    which the reference's Keras models rely on for SAME-padded pools)."""
+    (x,) = inputs
+    (wh, ww), (sh, sw), padding = _pool_dims(attrs)
+    dims, strides = (1, wh, ww, 1), (1, sh, sw, 1)
+    summed = lax.reduce_window(x, 0.0, lax.add, dims, strides, padding)
+    if padding == "VALID":
+        return summed / (wh * ww)
+    ones = jnp.ones(x.shape[1:3], x.dtype)[None, :, :, None]
+    counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, padding)
+    return summed / counts
+
+
+@register_op("global_avg_pool")
+def global_avg_pool_apply(params, inputs, attrs):
+    (x,) = inputs
+    out = jnp.mean(x, axis=(1, 2), keepdims=bool(attrs.get("keepdims", False)))
+    return out
+
+
+@register_op("global_max_pool")
+def global_max_pool_apply(params, inputs, attrs):
+    (x,) = inputs
+    return jnp.max(x, axis=(1, 2), keepdims=bool(attrs.get("keepdims", False)))
+
+
+@register_op("zero_pad")
+def zero_pad_apply(params, inputs, attrs):
+    (x,) = inputs
+    (pt, pb), (pl, pr) = [tuple(p) for p in attrs["padding"]]
+    return jnp.pad(x, ((0, 0), (pt, pb), (pl, pr), (0, 0)))
+
+
+@register_op("crop")
+def crop_apply(params, inputs, attrs):
+    (x,) = inputs
+    (ct, cb), (cl, cr) = [tuple(p) for p in attrs["cropping"]]
+    h, w = x.shape[1], x.shape[2]
+    return x[:, ct : h - cb, cl : w - cr, :]
+
+
+@register_op("flatten")
+def flatten_apply(params, inputs, attrs):
+    (x,) = inputs
+    return x.reshape(x.shape[0], -1)
+
+
+@register_op("reshape")
+def reshape_apply(params, inputs, attrs):
+    (x,) = inputs
+    return x.reshape((x.shape[0], *attrs["shape"]))
+
+
+@register_op("identity")
+def identity_apply(params, inputs, attrs):
+    (x,) = inputs
+    return x
+
+
+# Dropout at inference time is the identity (the reference only ever runs
+# inference: reference src/node.py:129 calls model.predict).
+@register_op("dropout")
+def dropout_apply(params, inputs, attrs):
+    (x,) = inputs
+    return x
+
+
+# --------------------------------------------------------------------------
+# merges
+# --------------------------------------------------------------------------
+
+
+@register_op("add")
+def add_apply(params, inputs, attrs):
+    out = inputs[0]
+    for x in inputs[1:]:
+        out = out + x
+    return out
+
+
+@register_op("multiply")
+def multiply_apply(params, inputs, attrs):
+    out = inputs[0]
+    for x in inputs[1:]:
+        out = out * x
+    return out
+
+
+@register_op("concat")
+def concat_apply(params, inputs, attrs):
+    return jnp.concatenate(list(inputs), axis=int(attrs.get("axis", -1)))
+
+
+# --------------------------------------------------------------------------
+# activations
+# --------------------------------------------------------------------------
+
+
+@register_op("relu")
+def relu_apply(params, inputs, attrs):
+    return jax.nn.relu(inputs[0])
+
+
+@register_op("relu6")
+def relu6_apply(params, inputs, attrs):
+    return jax.nn.relu6(inputs[0])
+
+
+@register_op("sigmoid")
+def sigmoid_apply(params, inputs, attrs):
+    return jax.nn.sigmoid(inputs[0])
+
+
+@register_op("tanh")
+def tanh_apply(params, inputs, attrs):
+    return jnp.tanh(inputs[0])
+
+
+@register_op("swish")
+def swish_apply(params, inputs, attrs):
+    return jax.nn.silu(inputs[0])
+
+
+@register_op("gelu")
+def gelu_apply(params, inputs, attrs):
+    return jax.nn.gelu(inputs[0], approximate=bool(attrs.get("approximate", True)))
+
+
+@register_op("softmax")
+def softmax_apply(params, inputs, attrs):
+    return jax.nn.softmax(inputs[0], axis=int(attrs.get("axis", -1)))
+
+
+@register_op("scale")
+def scale_apply(params, inputs, attrs):
+    """x * constant (InceptionResNetV2 residual scaling)."""
+    return inputs[0] * float(attrs["value"])

@@ -1,0 +1,64 @@
+"""The op registry: name -> (init, apply)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Mapping, Sequence
+
+import jax
+
+NodeParams = Mapping[str, jax.Array]
+InitFn = Callable[
+    [jax.Array, Mapping[str, Any], Sequence[tuple[int, ...]], Any], NodeParams
+]
+ApplyFn = Callable[[NodeParams, Sequence[jax.Array], Mapping[str, Any]], jax.Array]
+
+
+@dataclasses.dataclass(frozen=True)
+class Op:
+    """An op kind.
+
+    init(rng, attrs, in_shapes, param_dtype) -> params dict (maybe empty)
+    apply(params, inputs, attrs) -> output array
+    """
+
+    name: str
+    init: InitFn
+    apply: ApplyFn
+
+
+_REGISTRY: dict[str, Op] = {}
+
+
+def register_op(
+    name: str, *, init: InitFn | None = None
+) -> Callable[[ApplyFn], ApplyFn]:
+    """Decorator registering `apply` (and optional `init`) under `name`."""
+
+    def deco(apply_fn: ApplyFn) -> ApplyFn:
+        if name in _REGISTRY:
+            raise ValueError(f"op {name!r} already registered")
+        _REGISTRY[name] = Op(
+            name=name, init=init if init is not None else _no_params, apply=apply_fn
+        )
+        return apply_fn
+
+    return deco
+
+
+def _no_params(rng, attrs, in_shapes, param_dtype) -> NodeParams:
+    del rng, attrs, in_shapes, param_dtype
+    return {}
+
+
+def get_op(name: str) -> Op:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown op {name!r}; registered: {sorted(_REGISTRY)}"
+        ) from None
+
+
+def op_names() -> list[str]:
+    return sorted(_REGISTRY)

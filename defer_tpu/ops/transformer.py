@@ -1,0 +1,120 @@
+"""Transformer ops (for the BERT-base config in BASELINE.json).
+
+The reference never ran a transformer, but BERT-base encoder inference is
+in its benchmark config list (BASELINE.json "configs"); pipeline stages
+cut at encoder-block boundaries. Attention routes through
+defer_tpu.ops.attention so the Pallas flash-attention kernel can be
+swapped in on TPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from defer_tpu.ops.registry import register_op
+
+
+def _embedding_init(rng, attrs, in_shapes, param_dtype):
+    vocab = int(attrs["vocab_size"])
+    dim = int(attrs["features"])
+    table = jax.random.normal(rng, (vocab, dim), param_dtype) * 0.02
+    return {"table": table}
+
+
+@register_op("embedding", init=_embedding_init)
+def embedding_apply(params, inputs, attrs):
+    (ids,) = inputs
+    return jnp.take(params["table"], ids, axis=0)
+
+
+def _pos_embedding_init(rng, attrs, in_shapes, param_dtype):
+    max_len = int(attrs["max_len"])
+    dim = in_shapes[0][-1]
+    table = jax.random.normal(rng, (max_len, dim), param_dtype) * 0.02
+    return {"table": table}
+
+
+@register_op("pos_embedding", init=_pos_embedding_init)
+def pos_embedding_apply(params, inputs, attrs):
+    """Adds a learned positional embedding to (B, S, D)."""
+    (x,) = inputs
+    seq = x.shape[1]
+    return x + params["table"][:seq].astype(x.dtype)
+
+
+def _layer_norm_init(rng, attrs, in_shapes, param_dtype):
+    del rng
+    dim = in_shapes[0][-1]
+    return {
+        "scale": jnp.ones((dim,), param_dtype),
+        "bias": jnp.zeros((dim,), param_dtype),
+    }
+
+
+@register_op("layer_norm", init=_layer_norm_init)
+def layer_norm_apply(params, inputs, attrs):
+    (x,) = inputs
+    eps = float(attrs.get("eps", 1e-12))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    normed = (xf - mean) * jax.lax.rsqrt(var + eps)
+    out = normed * params["scale"].astype(jnp.float32) + params["bias"].astype(
+        jnp.float32
+    )
+    return out.astype(x.dtype)
+
+
+def _mha_init(rng, attrs, in_shapes, param_dtype):
+    dim = in_shapes[0][-1]
+    num_heads = int(attrs["num_heads"])
+    if dim % num_heads:
+        raise ValueError(f"model dim {dim} not divisible by {num_heads} heads")
+    keys = jax.random.split(rng, 4)
+    scale = dim**-0.5
+    return {
+        "wq": jax.random.normal(keys[0], (dim, dim), param_dtype) * scale,
+        "wk": jax.random.normal(keys[1], (dim, dim), param_dtype) * scale,
+        "wv": jax.random.normal(keys[2], (dim, dim), param_dtype) * scale,
+        "wo": jax.random.normal(keys[3], (dim, dim), param_dtype) * scale,
+        "bq": jnp.zeros((dim,), param_dtype),
+        "bk": jnp.zeros((dim,), param_dtype),
+        "bv": jnp.zeros((dim,), param_dtype),
+        "bo": jnp.zeros((dim,), param_dtype),
+    }
+
+
+@register_op("mha", init=_mha_init)
+def mha_apply(params, inputs, attrs):
+    """Multi-head self-attention on (B, S, D).
+
+    Optional second input: additive attention bias/mask broadcastable to
+    (B, heads, S, S).
+    """
+    from defer_tpu.ops.attention import multi_head_attention
+
+    x = inputs[0]
+    mask = inputs[1] if len(inputs) > 1 else None
+    num_heads = int(attrs["num_heads"])
+    dt = x.dtype
+    q = x @ params["wq"].astype(dt) + params["bq"].astype(dt)
+    k = x @ params["wk"].astype(dt) + params["bk"].astype(dt)
+    v = x @ params["wv"].astype(dt) + params["bv"].astype(dt)
+    out = multi_head_attention(
+        q,
+        k,
+        v,
+        num_heads=num_heads,
+        bias=mask,
+        causal=bool(attrs.get("causal", False)),
+        use_pallas=attrs.get("use_pallas", "auto"),
+    )
+    return out @ params["wo"].astype(dt) + params["bo"].astype(dt)
+
+
+@register_op("take_token")
+def take_token_apply(params, inputs, attrs):
+    """Select one sequence position, e.g. the [CLS] token: (B,S,D)->(B,D)."""
+    (x,) = inputs
+    return x[:, int(attrs.get("index", 0)), :]

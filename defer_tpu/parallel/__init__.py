@@ -1,0 +1,8 @@
+from defer_tpu.parallel.mesh import (
+    describe_topology,
+    make_mesh,
+    pipeline_devices,
+)
+from defer_tpu.parallel.pipeline import Pipeline
+
+__all__ = ["Pipeline", "describe_topology", "make_mesh", "pipeline_devices"]

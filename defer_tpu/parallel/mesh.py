@@ -1,0 +1,65 @@
+"""TPU topology discovery and mesh construction.
+
+The reference wires its "topology" by hand: a Python list of node IPs
+(reference src/test.py:20) plus a hard-coded dispatcher IP (reference
+src/dispatcher.py:25), with each node told its successor's address over a
+socket (reference src/dispatcher.py:54-58). Here topology comes from the
+JAX runtime: `jax.devices()` enumerates the slice, and meshes are built
+with `jax.sharding.Mesh` so collectives ride ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def describe_topology() -> dict:
+    """Human/bench-readable snapshot of the accelerator topology."""
+    devs = jax.devices()
+    return {
+        "backend": jax.default_backend(),
+        "num_devices": len(devs),
+        "num_local_devices": jax.local_device_count(),
+        "num_hosts": jax.process_count(),
+        "device_kind": devs[0].device_kind if devs else "none",
+    }
+
+
+def pipeline_devices(
+    num_stages: int, devices: Sequence[jax.Device] | None = None
+) -> list[jax.Device]:
+    """Pick one device per pipeline stage.
+
+    With fewer devices than stages, stages wrap round-robin (the
+    reference simply requires len(nodes) == len(stages) and crashes
+    otherwise, reference src/dispatcher.py:49); round-robin lets an
+    8-stage cut list still run on a 1- or 4-chip host, which is also how
+    the single-chip benchmark exercises multi-stage overhead honestly.
+    """
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if not devs:
+        raise RuntimeError("no JAX devices available")
+    return [devs[i % len(devs)] for i in range(num_stages)]
+
+
+def make_mesh(
+    axes: Mapping[str, int], devices: Sequence[jax.Device] | None = None
+) -> Mesh:
+    """Build a named mesh, e.g. make_mesh({"data": 2, "stage": 4}).
+
+    Axis order follows dict order; total size must match the device
+    count used.
+    """
+    devs = list(devices) if devices is not None else list(jax.devices())
+    shape = tuple(axes.values())
+    n = int(np.prod(shape)) if shape else 1
+    if n > len(devs):
+        raise ValueError(
+            f"mesh {dict(axes)} needs {n} devices, have {len(devs)}"
+        )
+    arr = np.array(devs[:n]).reshape(shape)
+    return Mesh(arr, tuple(axes.keys()))

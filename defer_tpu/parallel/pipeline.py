@@ -1,0 +1,184 @@
+"""Device-pinned pipeline runtime for heterogeneous stage chains.
+
+This is the TPU-native replacement for the reference's entire data plane:
+its per-node recv/compute/send thread pairs (reference src/node.py:97-133),
+bounded hand-off queues (src/node.py:139), TCP framing
+(src/node_state.py:43-101) and ZFP+LZ4 codec (src/node.py:93-96) all
+collapse into:
+
+  * one jit-compiled XLA program per stage, pinned to its own TPU core
+    (parameters committed there once at load, like the reference's
+    one-time weight dispatch, src/dispatcher.py:47-63);
+  * `jax.device_put` core-to-core activation transfers that ride ICI —
+    no serialization, no compression, no sockets;
+  * JAX's asynchronous dispatch as the pipelining engine: the host
+    enqueues microbatch t on stage 0 while stage k still computes
+    microbatch t-k, so all stages overlap exactly as the reference's
+    thread pipeline does, minus the Python in the hot loop.
+
+Backpressure (the reference's bounded queues, src/test.py:44) becomes a
+cap on in-flight microbatches enforced by blocking on the oldest result.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Any, Iterable, Iterator, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from defer_tpu.config import DeferConfig
+from defer_tpu.graph.ir import Graph, GraphParams
+from defer_tpu.graph.partition import stage_params
+from defer_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class Pipeline:
+    """A chain of jit-compiled stages, each pinned to one device."""
+
+    def __init__(
+        self,
+        stages: Sequence[Graph],
+        params: GraphParams,
+        devices: Sequence[jax.Device],
+        config: DeferConfig | None = None,
+    ):
+        if len(devices) != len(stages):
+            raise ValueError(
+                f"{len(stages)} stages need {len(stages)} devices, "
+                f"got {len(devices)}"
+            )
+        self.config = config or DeferConfig()
+        self.stages = list(stages)
+        self.devices = list(devices)
+        cd = self.config.compute_dtype
+
+        self.stage_params: list[Any] = []
+        self.stage_fns: list[Any] = []
+        # Non-donating twins, used where an input must survive the call
+        # (latency probing re-times the same activation repeatedly).
+        self._plain_fns: list[Any] = []
+        for i, (stage, dev) in enumerate(zip(self.stages, self.devices)):
+            sp = stage_params(params, stage)
+            sp = jax.device_put(sp, dev)
+            self.stage_params.append(sp)
+
+            def stage_apply(p, x, _stage=stage, _cd=cd):
+                return _stage.apply(p, x.astype(_cd))
+
+            # Stage 0's input is caller-owned (device_put of an array
+            # already on the device aliases it) — never donate that.
+            # Later stages consume pipeline-owned transfer buffers.
+            donate = (1,) if self.config.donate_activations and i > 0 else ()
+            self.stage_fns.append(jax.jit(stage_apply, donate_argnums=donate))
+            self._plain_fns.append(jax.jit(stage_apply))
+
+    @property
+    def num_stages(self) -> int:
+        return len(self.stages)
+
+    # -- execution -------------------------------------------------------
+
+    def __call__(self, x: jax.Array) -> jax.Array:
+        """Push one microbatch through the chain (async — the returned
+        array is a future; block_until_ready() to wait)."""
+        h = jax.device_put(x, self.devices[0])
+        for i, (fn, p) in enumerate(zip(self.stage_fns, self.stage_params)):
+            if i > 0:
+                h = jax.device_put(h, self.devices[i])
+            h = fn(p, h)
+        return h
+
+    def stream(
+        self,
+        inputs: Iterable[Any],
+        *,
+        max_inflight: int | None = None,
+    ) -> Iterator[jax.Array]:
+        """Stream microbatches through the pipeline with bounded
+        in-flight depth; yields outputs in order.
+
+        The analogue of the reference's steady-state hot loop
+        (SURVEY.md §3.3): feed thread + per-node threads + result
+        server, here a single loop over async dispatches.
+        """
+        depth = max_inflight or self.config.max_inflight
+        pending: collections.deque[jax.Array] = collections.deque()
+        for x in inputs:
+            pending.append(self(x))
+            # Emit everything already finished (without blocking), then
+            # enforce backpressure by blocking on the oldest result.
+            while pending and (len(pending) >= depth or pending[0].is_ready()):
+                out = pending.popleft()
+                out.block_until_ready()
+                yield out
+        while pending:
+            out = pending.popleft()
+            out.block_until_ready()
+            yield out
+
+    def warmup(self, x: Any) -> jax.Array:
+        """Compile every stage (first XLA compile is slow; do it before
+        timing — the analogue of the reference's settling sleep,
+        reference src/dispatcher.py:126, but deterministic)."""
+        out = self(x)
+        out.block_until_ready()
+        return out
+
+    # -- measurement -----------------------------------------------------
+
+    def probe_stage_latencies(
+        self, x: Any, iters: int = 10
+    ) -> list[dict[str, float]]:
+        """Per-stage p50/p99 latency in seconds, measured synchronously
+        (BASELINE.json's metric asks for per-stage p50). Run outside the
+        streaming loop so probing doesn't break overlap."""
+        h = jax.device_put(x, self.devices[0])
+        results = []
+        for i, (fn, p) in enumerate(zip(self._plain_fns, self.stage_params)):
+            if i > 0:
+                h = jax.device_put(h, self.devices[i])
+                h.block_until_ready()
+            fn(p, h).block_until_ready()  # ensure compiled
+            times = []
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                out = fn(p, h)
+                out.block_until_ready()
+                times.append(time.perf_counter() - t0)
+            times.sort()
+            results.append(
+                {
+                    "stage": i,
+                    "device": str(self.devices[i]),
+                    "p50_s": times[len(times) // 2],
+                    "p99_s": times[min(len(times) - 1, int(len(times) * 0.99))],
+                    "min_s": times[0],
+                }
+            )
+            h = fn(p, h)
+        return results
+
+    def throughput(
+        self, x: Any, num_microbatches: int = 256
+    ) -> dict[str, float]:
+        """Measure end-to-end streaming throughput (microbatches/sec and
+        items/sec), the analogue of the reference's timed result counting
+        (reference src/test.py:33-41)."""
+        self.warmup(x)
+        t0 = time.perf_counter()
+        n = 0
+        for _ in self.stream(x for _ in range(num_microbatches)):
+            n += 1
+        dt = time.perf_counter() - t0
+        batch = int(x.shape[0]) if hasattr(x, "shape") and x.ndim > 0 else 1
+        return {
+            "microbatches": n,
+            "seconds": dt,
+            "microbatches_per_sec": n / dt,
+            "items_per_sec": n * batch / dt,
+        }

@@ -1,0 +1,62 @@
+"""Host-side input feed and result drain.
+
+The reference's equivalents: `_startDistEdgeInference` pulls from the
+input queue, compresses, and sockets to node 0 (reference
+src/dispatcher.py:93-103); `_result_server` accepts the last node's
+connection and pushes decompressed results to the output queue
+(src/dispatcher.py:105-118). Here both ends are queue adapters around
+the async pipeline stream — `device_put` to stage 0's core replaces the
+socket send, fetching the output array replaces the result server.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+# End-of-stream sentinel a producer can put on the input queue (a None
+# works too). The reference's feed loop blocks forever on `input_q.get()`
+# (reference src/dispatcher.py:100) with no shutdown path at all.
+STOP = object()
+
+
+class ProgressMonitor:
+    """Deadlock watchdog for the streaming loop.
+
+    The reference hangs forever if a node dies mid-stream (single
+    accepted peer, no timeout on the data path — reference
+    src/node.py:102-103). Here: if no microbatch completes within
+    `timeout_s` while work is outstanding, `check()` raises.
+    """
+
+    def __init__(self, timeout_s: float):
+        self.timeout_s = timeout_s
+        self._last_progress = time.monotonic()
+        self._outstanding = 0
+        self._lock = threading.Lock()
+
+    def submitted(self) -> None:
+        with self._lock:
+            if self._outstanding == 0:
+                # Idle time (or first-compile time) before this submission
+                # must not count against the watchdog.
+                self._last_progress = time.monotonic()
+            self._outstanding += 1
+
+    def completed(self) -> None:
+        with self._lock:
+            self._outstanding -= 1
+            self._last_progress = time.monotonic()
+
+    def check(self) -> None:
+        with self._lock:
+            stalled = (
+                self._outstanding > 0
+                and time.monotonic() - self._last_progress > self.timeout_s
+            )
+        if stalled:
+            raise TimeoutError(
+                f"pipeline made no progress for {self.timeout_s:.0f}s with "
+                f"{self._outstanding} microbatch(es) outstanding — a stage "
+                "or transfer is stuck"
+            )

@@ -1,0 +1,33 @@
+"""Structured logging.
+
+The reference's only observability is `print("[DEBUG] ...")` scattered
+through dispatcher and node (e.g. reference src/dispatcher.py:63,69,96,
+src/node.py:29,32,41). Here: standard `logging` with one shared
+formatter, quiet by default, DEBUG via DEFER_TPU_LOGLEVEL.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+_CONFIGURED = False
+
+
+def get_logger(name: str) -> logging.Logger:
+    global _CONFIGURED
+    if not _CONFIGURED:
+        level = os.environ.get("DEFER_TPU_LOGLEVEL", "WARNING").upper()
+        handler = logging.StreamHandler()
+        handler.setFormatter(
+            logging.Formatter(
+                "%(asctime)s %(levelname)s %(name)s: %(message)s",
+                datefmt="%H:%M:%S",
+            )
+        )
+        root = logging.getLogger("defer_tpu")
+        root.addHandler(handler)
+        root.setLevel(level)
+        root.propagate = False
+        _CONFIGURED = True
+    return logging.getLogger(name)

@@ -1,0 +1,121 @@
+"""Graph IR: construction, validation, execution, memoization."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from defer_tpu.graph.ir import Graph, GraphBuilder, GraphError, OpNode
+from defer_tpu.ops import get_op, op_names, register_op
+
+
+def tiny_residual_graph():
+    """input -> dense -> relu -> [dense branch] -> add -> dense_out."""
+    b = GraphBuilder("tiny")
+    x = b.input()
+    h = b.add("dense", x, name="d1", features=8)
+    h = b.add("relu", h, name="r1")
+    br = b.add("dense", h, name="d2", features=8)
+    s = b.add("add", h, br, name="add_1")
+    out = b.add("dense", s, name="d3", features=4)
+    return b.build(out)
+
+
+def test_builder_and_topology():
+    g = tiny_residual_graph()
+    assert g.input_name == "input"
+    assert g.output_name == "d3"
+    assert [n.name for n in g.nodes] == [
+        "input", "d1", "r1", "d2", "add_1", "d3",
+    ]
+
+
+def test_builder_rejects_unknown_input():
+    b = GraphBuilder("bad")
+    b.input()
+    with pytest.raises(GraphError):
+        b.add("dense", "nope", features=4)
+
+
+def test_graph_rejects_non_topological_order():
+    with pytest.raises(GraphError):
+        Graph(
+            name="bad",
+            nodes=(
+                OpNode("a", "relu", ("b",)),
+                OpNode("b", "input", ()),
+            ),
+            input_name="b",
+            output_name="a",
+        )
+
+
+def test_graph_rejects_duplicate_names():
+    with pytest.raises(GraphError):
+        Graph(
+            name="bad",
+            nodes=(OpNode("a", "input", ()), OpNode("a", "relu", ("a",))),
+            input_name="a",
+            output_name="a",
+        )
+
+
+def test_init_and_apply_shapes():
+    g = tiny_residual_graph()
+    params = g.init(jax.random.key(0), (2, 16))
+    x = jnp.ones((2, 16))
+    y = g.apply(params, x)
+    assert y.shape == (2, 4)
+    spec = g.output_spec(params, (2, 16))
+    assert spec.shape == (2, 4)
+
+
+def test_apply_matches_manual_computation():
+    g = tiny_residual_graph()
+    params = g.init(jax.random.key(0), (3, 16))
+    x = jax.random.normal(jax.random.key(1), (3, 16))
+    h = x @ params["d1"]["kernel"] + params["d1"]["bias"]
+    h = np.maximum(h, 0)
+    br = h @ params["d2"]["kernel"] + params["d2"]["bias"]
+    s = h + br
+    want = s @ params["d3"]["kernel"] + params["d3"]["bias"]
+    got = g.apply(params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5)
+
+
+def test_multipath_node_evaluated_once():
+    """The reference re-executes ops reachable along multiple paths
+    (reference src/dag_util.py:18-19); the IR must not."""
+    calls = {"n": 0}
+
+    if "counting_op" not in op_names():
+
+        @register_op("counting_op")
+        def counting_apply(params, inputs, attrs):  # noqa: ANN001
+            calls["n"] += 1
+            return inputs[0] * 2.0
+
+    b = GraphBuilder("diamond")
+    x = b.input()
+    shared = b.add("counting_op", x, name="shared")
+    l = b.add("relu", shared, name="left")
+    r = b.add("tanh", shared, name="right")
+    out = b.add("add", l, r, name="join")
+    g = b.build(out)
+    params = g.init(jax.random.key(0), (1, 4))
+    calls["n"] = 0
+    g.apply(params, jnp.ones((1, 4)))
+    assert calls["n"] == 1
+
+
+def test_infer_shapes_covers_all_nodes():
+    g = tiny_residual_graph()
+    params = g.init(jax.random.key(0), (2, 16))
+    specs = g.infer_shapes(params, (2, 16))
+    assert set(specs) == {n.name for n in g.nodes}
+    assert specs["add_1"].shape == (2, 8)
+
+
+def test_op_registry_unknown_op():
+    with pytest.raises(KeyError):
+        get_op("definitely_not_an_op")

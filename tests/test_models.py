@@ -1,0 +1,52 @@
+"""Model zoo: build, shape-check, and validate default cut points."""
+
+import jax
+import pytest
+
+from defer_tpu.graph.partition import validate_cut_points
+from defer_tpu.models import get_model, model_names
+
+
+def test_model_registry_lists_models():
+    names = model_names()
+    assert "resnet50" in names
+    assert "vgg19" in names
+
+
+@pytest.mark.parametrize("name", ["resnet50", "vgg16", "vgg19"])
+def test_cnn_builds_and_has_valid_cuts(name):
+    model = get_model(name)
+    assert model.input_shape == (224, 224, 3)
+    for n in (2, 4, 8):
+        cuts = model.default_cuts(n)
+        assert len(cuts) == n - 1
+        validate_cut_points(model.graph, cuts)
+
+
+def test_resnet50_output_shape():
+    model = get_model("resnet50")
+    params = model.graph.init(jax.random.key(0), (2, 64, 64, 3))
+    spec = model.graph.output_spec(params, (2, 64, 64, 3))
+    assert spec.shape == (2, 1000)
+
+
+def test_default_cuts_exact_count_at_limit():
+    """num_stages == len(candidates)+1 must not silently collapse cuts."""
+    model = get_model("resnet50")
+    cuts = model.default_cuts(17)
+    assert len(cuts) == 16 and len(set(cuts)) == 16
+    with pytest.raises(ValueError, match="cannot make 18"):
+        model.default_cuts(18)
+
+
+def test_resnet50_has_16_adds():
+    model = get_model("resnet50")
+    assert model.cut_candidates == tuple(f"add_{i}" for i in range(1, 17))
+
+
+def test_vgg19_output_shape():
+    model = get_model("vgg19")
+    # VGG's flatten->dense head fixes the input resolution at 224.
+    params = model.graph.init(jax.random.key(0), (1, 224, 224, 3))
+    spec = model.graph.output_spec(params, (1, 224, 224, 3))
+    assert spec.shape == (1, 1000)

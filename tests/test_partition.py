@@ -1,0 +1,120 @@
+"""Partitioner: validation + the compose(stages) == full_model property
+(the test strategy SURVEY.md §4 prescribes)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from defer_tpu.graph.ir import GraphBuilder
+from defer_tpu.graph.partition import (
+    PartitionError,
+    partition,
+    stage_params,
+    validate_cut_points,
+)
+from defer_tpu.models import get_model
+
+
+def residual_chain():
+    """Two residual blocks; adds are valid cuts, branch interiors are not."""
+    b = GraphBuilder("chain")
+    x = b.input()
+    h = b.add("dense", x, name="stem", features=8)
+    for i in (1, 2):
+        br = b.add("dense", h, name=f"blk{i}_dense", features=8)
+        br = b.add("relu", br, name=f"blk{i}_relu")
+        h = b.add("add", h, br, name=f"add_{i}")
+    out = b.add("dense", h, name="head", features=4)
+    return b.build(out)
+
+
+def test_valid_cuts_pass():
+    g = residual_chain()
+    validate_cut_points(g, ["add_1"])
+    validate_cut_points(g, ["add_1", "add_2"])
+    validate_cut_points(g, ["stem"])
+
+
+def test_cut_inside_residual_branch_rejected():
+    """The reference silently miscompiles this case (SURVEY.md §3.4)."""
+    g = residual_chain()
+    with pytest.raises(PartitionError, match="articulation"):
+        validate_cut_points(g, ["blk1_relu"])
+
+
+def test_unknown_and_duplicate_and_boundary_cuts_rejected():
+    g = residual_chain()
+    with pytest.raises(PartitionError, match="not a node"):
+        validate_cut_points(g, ["nope"])
+    with pytest.raises(PartitionError, match="duplicate"):
+        validate_cut_points(g, ["add_1", "add_1"])
+    with pytest.raises(PartitionError, match="input/output"):
+        validate_cut_points(g, ["input"])
+    with pytest.raises(PartitionError, match="chain order"):
+        validate_cut_points(g, ["add_2", "add_1"])
+
+
+def test_partition_structure():
+    g = residual_chain()
+    stages = partition(g, ["add_1"])
+    assert len(stages) == 2
+    s0, s1 = stages
+    assert s0.output_name == "add_1"
+    assert s1.input_name == "add_1"
+    assert s1.output_name == "head"
+    names0 = {n.name for n in s0.nodes}
+    names1 = {n.name for n in s1.nodes}
+    # Each compute op lives in exactly one stage; only the cut node name
+    # appears on both sides (as output / as input placeholder).
+    assert names0 & names1 == {"add_1"}
+    all_names = {n.name for n in g.nodes}
+    assert names0 | names1 == all_names
+
+
+def compose(stages, params, x):
+    h = x
+    for s in stages:
+        h = s.apply(stage_params(params, s), h)
+    return h
+
+
+def test_compose_equals_full_small():
+    g = residual_chain()
+    params = g.init(jax.random.key(0), (4, 8))
+    x = jax.random.normal(jax.random.key(1), (4, 8))
+    full = g.apply(params, x)
+    for cuts in (["add_1"], ["add_1", "add_2"], ["stem", "add_2"]):
+        stages = partition(g, cuts)
+        got = compose(stages, params, x)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(full), rtol=1e-5
+        )
+
+
+def test_compose_equals_full_resnet50():
+    """End-to-end on the real headline model at a reduced resolution,
+    cut at the reference's documented 8-way list (reference
+    src/test.py:27)."""
+    model = get_model("resnet50")
+    params = model.graph.init(jax.random.key(0), (1, 64, 64, 3))
+    x = jax.random.normal(jax.random.key(1), (1, 64, 64, 3))
+    full = jax.jit(model.graph.apply)(params, x)
+    cuts = ["add_2", "add_4", "add_6", "add_8", "add_10", "add_12", "add_14"]
+    stages = partition(model.graph, cuts)
+    assert len(stages) == 8
+    got = compose(stages, params, x)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(full), rtol=2e-4, atol=2e-6
+    )
+
+
+def test_stage_params_partition_params_exactly():
+    g = residual_chain()
+    params = g.init(jax.random.key(0), (4, 8))
+    stages = partition(g, ["add_1"])
+    p0 = stage_params(params, stages[0])
+    p1 = stage_params(params, stages[1])
+    parameterized = {k for k, v in params.items() if v}
+    assert set(p0) | set(p1) == parameterized
+    assert not set(p0) & set(p1)

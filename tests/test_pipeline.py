@@ -1,0 +1,85 @@
+"""Device-pinned pipeline on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from defer_tpu.config import DeferConfig
+from defer_tpu.graph.partition import partition
+from defer_tpu.models import get_model
+from defer_tpu.parallel.mesh import make_mesh, pipeline_devices
+from defer_tpu.parallel.pipeline import Pipeline
+from tests.test_partition import residual_chain
+
+
+F32 = DeferConfig(compute_dtype=jnp.float32)
+
+
+def test_pipeline_matches_single_device(devices):
+    g = residual_chain()
+    params = g.init(jax.random.key(0), (4, 8))
+    x = jax.random.normal(jax.random.key(1), (4, 8))
+    want = g.apply(params, x)
+    stages = partition(g, ["add_1", "add_2"])
+    pipe = Pipeline(stages, params, devices[:3], config=F32)
+    got = pipe.warmup(x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5)
+    # Params really live on distinct devices.
+    assert {
+        d
+        for p in pipe.stage_params
+        for a in jax.tree_util.tree_leaves(p)
+        for d in a.sharding.device_set
+    } == set(devices[:3])
+
+
+def test_stream_preserves_order(devices):
+    g = residual_chain()
+    params = g.init(jax.random.key(0), (1, 8))
+    stages = partition(g, ["add_1"])
+    pipe = Pipeline(stages, params, devices[:2], config=F32)
+    xs = [jnp.full((1, 8), float(i)) for i in range(20)]
+    outs = list(pipe.stream(iter(xs), max_inflight=4))
+    assert len(outs) == 20
+    for x, out in zip(xs, outs):
+        want = g.apply(params, x)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(want), rtol=1e-5
+        )
+
+
+def test_resnet50_8stage_pipeline(devices):
+    """The headline configuration: ResNet50 cut 8 ways over 8 devices
+    (reference src/test.py:27 documents this cut list)."""
+    model = get_model("resnet50")
+    params = model.graph.init(jax.random.key(0), (1, 64, 64, 3))
+    x = jax.random.normal(jax.random.key(1), (1, 64, 64, 3))
+    want = jax.jit(model.graph.apply)(params, x)
+    cuts = ["add_2", "add_4", "add_6", "add_8", "add_10", "add_12", "add_14"]
+    stages = partition(model.graph, cuts)
+    pipe = Pipeline(stages, params, pipeline_devices(8, devices), config=F32)
+    outs = list(pipe.stream(iter([x] * 4)))
+    assert len(outs) == 4
+    for out in outs:
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(want), rtol=2e-4, atol=2e-6
+        )
+
+
+def test_probe_and_throughput_run(devices):
+    g = residual_chain()
+    params = g.init(jax.random.key(0), (1, 8))
+    stages = partition(g, ["add_1"])
+    pipe = Pipeline(stages, params, devices[:2], config=F32)
+    x = jnp.ones((1, 8))
+    lat = pipe.probe_stage_latencies(x, iters=3)
+    assert len(lat) == 2
+    assert all(r["p50_s"] > 0 for r in lat)
+    stats = pipe.throughput(x, num_microbatches=8)
+    assert stats["microbatches"] == 8
+    assert stats["items_per_sec"] > 0
+
+
+def test_make_mesh(devices):
+    mesh = make_mesh({"data": 2, "stage": 4}, devices)
+    assert mesh.shape == {"data": 2, "stage": 4}
