@@ -25,6 +25,7 @@ import time
 from typing import Any, Sequence
 
 import jax
+import jax.numpy as jnp
 
 from defer_tpu.config import DeferConfig, normalize_cuts
 from defer_tpu.graph.ir import Graph, GraphParams
@@ -34,6 +35,7 @@ from defer_tpu.parallel.mesh import pipeline_devices
 from defer_tpu.parallel.pipeline import Pipeline
 from defer_tpu.runtime.host_io import STOP, ProgressMonitor
 from defer_tpu.utils.logging import get_logger
+from defer_tpu.utils.sync import hard_sync, hard_sync_timeout
 
 log = get_logger(__name__)
 
@@ -125,21 +127,44 @@ class DEFER:
         depth = self.config.max_inflight
         since_probe = 0
 
-        def wait_ready(arr: Any) -> None:
-            # Poll instead of a bare block_until_ready so the watchdog
-            # can fire even while we're waiting on a stuck stage.
-            while not arr.is_ready():
-                monitor.check()
-                time.sleep(0.02)
+        def emit() -> None:
+            monitor.completed()
+            output_stream.put(pending.popleft())
+
+        def barrier(arr: Any) -> None:
+            # Fetch-based barrier with a deadline so a stuck stage trips
+            # the watchdog instead of hanging forever (utils/sync.py).
+            # A barrier may cover many microbatches; on timeout we only
+            # raise if not even the OLDEST pending item has finished —
+            # i.e. genuinely zero progress, matching collective_timeout_s
+            # semantics for slow-but-healthy pipelines.
+            while not hard_sync_timeout(
+                arr, self.config.collective_timeout_s
+            ):
+                if not (pending and pending[0].is_ready()):
+                    raise TimeoutError(
+                        f"pipeline made no progress for "
+                        f"{self.config.collective_timeout_s:.0f}s — a stage "
+                        "or transfer is stuck"
+                    )
+                while pending and pending[0].is_ready():
+                    emit()
 
         def drain(block: bool) -> None:
-            while pending and (
-                block or len(pending) >= depth or pending[0].is_ready()
-            ):
-                wait_ready(pending[0])
-                out = pending.popleft()
-                monitor.completed()
-                output_stream.put(out)
+            # Emit whatever is known-finished; under depth pressure (or
+            # at end of stream) take one batched barrier that retires a
+            # whole prefix — never wait per item (see Pipeline.stream).
+            while pending and pending[0].is_ready():
+                emit()
+            if block and pending:
+                barrier(pending[-1])
+                while pending:
+                    emit()
+            elif len(pending) >= depth:
+                k = len(pending) // 2
+                barrier(pending[k])
+                for _ in range(k + 1):
+                    emit()
 
         # Unlike Pipeline.stream (pull-based), this loop must keep
         # emitting results while the input queue idles — the reference's
@@ -197,10 +222,13 @@ def run_local_inference(
         params = model.init(jax.random.key(0), batch_size=batch_size)
     x = model.example_input(batch_size)
 
-    fn = jax.jit(
-        lambda p, v: model.graph.apply(p, v.astype(cfg.compute_dtype))
-    )
-    fn(params, x).block_until_ready()  # compile
+    def apply(p, v):
+        if jnp.issubdtype(v.dtype, jnp.floating):
+            v = v.astype(cfg.compute_dtype)
+        return model.graph.apply(p, v)
+
+    fn = jax.jit(apply)
+    hard_sync(fn(params, x))  # compile
 
     count = 0
     t0 = time.perf_counter()
@@ -209,9 +237,12 @@ def run_local_inference(
         pending.append(fn(params, x))
         count += 1
         if len(pending) >= 16:
-            pending.pop(0).block_until_ready()
-    for out in pending:
-        out.block_until_ready()
+            # Batched barrier: retire half the window with one fetch.
+            hard_sync(pending[7])
+            del pending[:8]
+    if pending:
+        # True completion barrier; device program order covers the rest.
+        hard_sync(pending[-1])
     dt = time.perf_counter() - t0
     return {
         "count": count,

@@ -123,7 +123,7 @@ class Graph:
         input_shape: Sequence[int],
         *,
         param_dtype: Any = jnp.float32,
-        compute_dtype: Any = jnp.float32,
+        input_dtype: Any = jnp.float32,
     ) -> GraphParams:
         """Initialize parameters for every node.
 
@@ -138,7 +138,7 @@ class Graph:
         for node in self.nodes:
             if node.op == INPUT_OP:
                 shapes[node.name] = tuple(input_shape)
-                dtypes[node.name] = compute_dtype
+                dtypes[node.name] = input_dtype
                 params[node.name] = {}
                 continue
             op = get_op(node.op)

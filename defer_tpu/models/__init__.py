@@ -45,11 +45,12 @@ class Model:
         param_dtype: Any = jnp.float32,
         compute_dtype: Any = jnp.float32,
     ) -> GraphParams:
+        del compute_dtype  # shape inference only needs the input dtype
         return self.graph.init(
             rng,
             (batch_size, *self.input_shape),
             param_dtype=param_dtype,
-            compute_dtype=compute_dtype,
+            input_dtype=self.input_dtype,
         )
 
     def example_input(

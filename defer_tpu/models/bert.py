@@ -1,1 +1,239 @@
-"""bert — implemented in a later milestone this round."""
+"""BERT-base encoder (BASELINE.json config: "BERT-base encoder inference
+(Keras-NLP, transformer stages)").
+
+Two forms:
+
+  * IR graph (`bert_base`) — token-id input, embeddings, 12 encoder
+    blocks, CLS pooler. Cut candidates are the block outputs
+    (`encoder_{i}_out`), so the DEFER-style heterogeneous pipeline cuts
+    it at block boundaries exactly as the reference would have cut a
+    Keras BERT.
+  * SPMD form (`SpmdBert`) — the TPU-first path: stacked encoder blocks
+    on the shard_map circular pipeline (defer_tpu/parallel), composing
+    pipeline/data/tensor mesh axes in one jitted program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from defer_tpu.graph.ir import GraphBuilder
+from defer_tpu.models import Model, register_model
+from defer_tpu.parallel.spmd_pipeline import (
+    make_spmd_pipeline,
+    stack_for_stages,
+    staged_specs,
+)
+from defer_tpu.parallel.transformer_stack import (
+    TransformerConfig,
+    init_stack,
+    layers_apply,
+    stack_specs,
+)
+
+
+def _build_bert(
+    name: str,
+    *,
+    num_layers: int,
+    dim: int,
+    num_heads: int,
+    ffn_dim: int,
+    vocab_size: int,
+    max_len: int,
+    seq_len: int,
+) -> Model:
+    b = GraphBuilder(name)
+    ids = b.input("input_ids")
+    x = b.add(
+        "embedding",
+        ids,
+        name="token_embedding",
+        vocab_size=vocab_size,
+        features=dim,
+    )
+    x = b.add("pos_embedding", x, name="position_embedding", max_len=max_len)
+    x = b.add("layer_norm", x, name="embeddings_ln")
+    cuts: list[str] = []
+    for i in range(num_layers):
+        attn = b.add("mha", x, name=f"encoder_{i}_mha", num_heads=num_heads)
+        x = b.add("add", x, attn, name=f"encoder_{i}_attn_add")
+        x = b.add("layer_norm", x, name=f"encoder_{i}_attn_ln")
+        h = b.add("dense", x, name=f"encoder_{i}_ffn_in", features=ffn_dim)
+        h = b.add("gelu", h, name=f"encoder_{i}_ffn_gelu")
+        h = b.add("dense", h, name=f"encoder_{i}_ffn_out", features=dim)
+        x = b.add("add", x, h, name=f"encoder_{i}_ffn_add")
+        x = b.add("layer_norm", x, name=f"encoder_{i}_out")
+        cuts.append(x)
+    cls = b.add("take_token", x, name="cls_token", index=0)
+    pooled = b.add("dense", cls, name="pooler_dense", features=dim)
+    pooled = b.add("tanh", pooled, name="pooler")
+    return Model(
+        name=name,
+        graph=b.build(pooled),
+        input_shape=(seq_len,),
+        input_dtype=jnp.int32,
+        cut_candidates=tuple(cuts[:-1]),  # last block output == graph tail
+    )
+
+
+@register_model("bert_base")
+def bert_base(seq_len: int = 128) -> Model:
+    return _build_bert(
+        "bert_base",
+        num_layers=12,
+        dim=768,
+        num_heads=12,
+        ffn_dim=3072,
+        vocab_size=30522,
+        max_len=512,
+        seq_len=seq_len,
+    )
+
+
+@register_model("bert_tiny")
+def bert_tiny(seq_len: int = 16) -> Model:
+    """Small config for tests / CPU meshes."""
+    return _build_bert(
+        "bert_tiny",
+        num_layers=4,
+        dim=32,
+        num_heads=4,
+        ffn_dim=64,
+        vocab_size=128,
+        max_len=64,
+        seq_len=seq_len,
+    )
+
+
+# --------------------------------------------------------------------------
+# SPMD form
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class SpmdBert:
+    """BERT encoder on the shard_map circular pipeline.
+
+    Mesh axes (any may be size 1): "data" (batch), "stage" (pipeline),
+    "model" (tensor parallel). One jitted step runs
+    embed -> S-stage ppermute pipeline -> pooler.
+    """
+
+    mesh: Mesh
+    cfg: TransformerConfig
+    compute_dtype: Any = jnp.bfloat16
+
+    def __post_init__(self):
+        self.num_stages = self.mesh.shape.get("stage", 1)
+        self.tp_axis = "model" if self.mesh.shape.get("model", 1) > 1 else None
+        if self.cfg.num_layers % self.num_stages:
+            raise ValueError(
+                f"{self.cfg.num_layers} layers not divisible by "
+                f"{self.num_stages} pipeline stages"
+            )
+        tp = self.mesh.shape.get("model", 1)
+        if self.cfg.num_heads % tp or self.cfg.dim % tp or self.cfg.ffn_dim % tp:
+            raise ValueError(
+                f"heads={self.cfg.num_heads}, dim={self.cfg.dim}, "
+                f"ffn_dim={self.cfg.ffn_dim} must all divide by the model "
+                f"axis size {tp} — otherwise attention silently computes "
+                "with the wrong head grouping"
+            )
+
+    def _stack_shardings(self):
+        from jax.sharding import NamedSharding
+
+        specs = staged_specs(stack_specs(None, self.tp_axis), "stage")
+        return jax.tree_util.tree_map(
+            lambda s: NamedSharding(self.mesh, s),
+            specs,
+            is_leaf=lambda s: isinstance(s, P),
+        )
+
+    def init(self, rng: jax.Array) -> dict:
+        k_embed, k_stack, k_pool = jax.random.split(rng, 3)
+        cfg = self.cfg
+        stacked = jax.device_put(
+            stack_for_stages(init_stack(k_stack, cfg), self.num_stages),
+            self._stack_shardings(),
+        )
+        from jax.sharding import NamedSharding
+
+        rep = NamedSharding(self.mesh, P())
+        return {
+            "token_embedding": jax.device_put(
+                jax.random.normal(k_embed, (cfg.vocab_size, cfg.dim)) * 0.02,
+                rep,
+            ),
+            "pos_embedding": jax.device_put(
+                jax.random.normal(
+                    jax.random.fold_in(k_embed, 1), (cfg.max_len, cfg.dim)
+                )
+                * 0.02,
+                rep,
+            ),
+            "pooler_w": jax.device_put(
+                jax.random.normal(k_pool, (cfg.dim, cfg.dim)) * cfg.dim**-0.5,
+                rep,
+            ),
+            "pooler_b": jax.device_put(jnp.zeros((cfg.dim,)), rep),
+            "stack": stacked,
+        }
+
+    def make_step(self):
+        """Jitted (params, ids [M, B, S]) -> pooled [M, B, D]."""
+        cfg = self.cfg
+        cd = self.compute_dtype
+
+        def stage_fn(stack_local, x):
+            return layers_apply(stack_local, x, cfg, tp_axis=self.tp_axis)
+
+        pipe = make_spmd_pipeline(
+            self.mesh,
+            stage_fn,
+            staged_specs(stack_specs(None, self.tp_axis), "stage"),
+            stage_axis="stage",
+            data_axis="data" if self.mesh.shape.get("data", 1) > 1 else None,
+        )
+
+        def step(params, ids):
+            seq = ids.shape[-1]
+            emb = jnp.take(params["token_embedding"], ids, axis=0)
+            emb = emb + params["pos_embedding"][:seq]
+            xs = emb.astype(cd)  # [M, B, S, D]
+            ys = pipe(params["stack"], xs)  # [M, B, S, D]
+            cls = ys[:, :, 0, :]
+            return jnp.tanh(
+                cls @ params["pooler_w"].astype(cd)
+                + params["pooler_b"].astype(cd)
+            )
+
+        return jax.jit(step)
+
+    def reference_apply(self, params: dict, ids: jax.Array) -> jax.Array:
+        """Unpipelined single-program reference for correctness checks."""
+        cfg = self.cfg
+        cd = self.compute_dtype
+        seq = ids.shape[-1]
+        emb = jnp.take(params["token_embedding"], ids, axis=0)
+        emb = (emb + params["pos_embedding"][:seq]).astype(cd)
+        # Undo the stage stacking: [S, L/S, ...] -> [L, ...]
+        flat = jax.tree_util.tree_map(
+            lambda a: jnp.asarray(a).reshape(-1, *a.shape[2:]),
+            params["stack"],
+        )
+        out = jnp.stack(
+            [layers_apply(flat, emb[m], cfg) for m in range(emb.shape[0])]
+        )
+        cls = out[:, :, 0, :]
+        return jnp.tanh(
+            cls @ params["pooler_w"].astype(cd)
+            + params["pooler_b"].astype(cd)
+        )

@@ -33,6 +33,7 @@ from defer_tpu.config import DeferConfig
 from defer_tpu.graph.ir import Graph, GraphParams
 from defer_tpu.graph.partition import stage_params
 from defer_tpu.utils.logging import get_logger
+from defer_tpu.utils.sync import hard_sync
 
 log = get_logger(__name__)
 
@@ -68,7 +69,10 @@ class Pipeline:
             self.stage_params.append(sp)
 
             def stage_apply(p, x, _stage=stage, _cd=cd):
-                return _stage.apply(p, x.astype(_cd))
+                # Integer inputs (token ids) must keep their dtype.
+                if jnp.issubdtype(x.dtype, jnp.floating):
+                    x = x.astype(_cd)
+                return _stage.apply(p, x)
 
             # Stage 0's input is caller-owned (device_put of an array
             # already on the device aliases it) — never donate that.
@@ -83,13 +87,22 @@ class Pipeline:
 
     # -- execution -------------------------------------------------------
 
+    @staticmethod
+    def _place(x: Any, dev: jax.Device) -> jax.Array:
+        """device_put only when the array isn't already resident on
+        `dev` — a redundant device_put of a host-uncommitted array
+        re-transfers the whole buffer from the host."""
+        if isinstance(x, jax.Array) and x.sharding.device_set == {dev}:
+            return x
+        return jax.device_put(x, dev)
+
     def __call__(self, x: jax.Array) -> jax.Array:
         """Push one microbatch through the chain (async — the returned
         array is a future; block_until_ready() to wait)."""
-        h = jax.device_put(x, self.devices[0])
+        h = self._place(x, self.devices[0])
         for i, (fn, p) in enumerate(zip(self.stage_fns, self.stage_params)):
             if i > 0:
-                h = jax.device_put(h, self.devices[i])
+                h = self._place(h, self.devices[i])
             h = fn(p, h)
         return h
 
@@ -110,23 +123,29 @@ class Pipeline:
         pending: collections.deque[jax.Array] = collections.deque()
         for x in inputs:
             pending.append(self(x))
-            # Emit everything already finished (without blocking), then
-            # enforce backpressure by blocking on the oldest result.
-            while pending and (len(pending) >= depth or pending[0].is_ready()):
-                out = pending.popleft()
-                out.block_until_ready()
-                yield out
-        while pending:
-            out = pending.popleft()
-            out.block_until_ready()
-            yield out
+            # Opportunistically emit anything already known-finished.
+            while pending and pending[0].is_ready():
+                yield pending.popleft()
+            if len(pending) >= depth:
+                # Backpressure: one barrier on the middle of the window
+                # retires the whole prefix (device program order) — never
+                # wait per item; completion notification can cost ~ms
+                # each, a batched barrier amortizes it (utils/sync.py).
+                k = len(pending) // 2
+                hard_sync(pending[k])
+                for _ in range(k + 1):
+                    yield pending.popleft()
+        if pending:
+            hard_sync(pending[-1])
+            while pending:
+                yield pending.popleft()
 
     def warmup(self, x: Any) -> jax.Array:
         """Compile every stage (first XLA compile is slow; do it before
         timing — the analogue of the reference's settling sleep,
         reference src/dispatcher.py:126, but deterministic)."""
         out = self(x)
-        out.block_until_ready()
+        hard_sync(out)
         return out
 
     # -- measurement -----------------------------------------------------
@@ -137,20 +156,27 @@ class Pipeline:
         """Per-stage p50/p99 latency in seconds, measured synchronously
         (BASELINE.json's metric asks for per-stage p50). Run outside the
         streaming loop so probing doesn't break overlap."""
-        h = jax.device_put(x, self.devices[0])
+        h = self._place(x, self.devices[0])
         results = []
         for i, (fn, p) in enumerate(zip(self._plain_fns, self.stage_params)):
             if i > 0:
-                h = jax.device_put(h, self.devices[i])
-                h.block_until_ready()
-            fn(p, h).block_until_ready()  # ensure compiled
+                h = self._place(h, self.devices[i])
+                hard_sync(h)
+            hard_sync(fn(p, h))  # ensure compiled
             times = []
             for _ in range(iters):
                 t0 = time.perf_counter()
                 out = fn(p, h)
-                out.block_until_ready()
+                hard_sync(out)
                 times.append(time.perf_counter() - t0)
             times.sort()
+            # Amortized per-call time: dispatch a window, one barrier.
+            # Excludes the per-call host sync round trip, which on
+            # tunneled transports dwarfs the stage itself.
+            t0 = time.perf_counter()
+            outs = [fn(p, h) for _ in range(iters)]
+            hard_sync(outs[-1])
+            amortized = (time.perf_counter() - t0) / iters
             results.append(
                 {
                     "stage": i,
@@ -158,6 +184,7 @@ class Pipeline:
                     "p50_s": times[len(times) // 2],
                     "p99_s": times[min(len(times) - 1, int(len(times) * 0.99))],
                     "min_s": times[0],
+                    "amortized_s": amortized,
                 }
             )
             h = fn(p, h)
@@ -172,8 +199,13 @@ class Pipeline:
         self.warmup(x)
         t0 = time.perf_counter()
         n = 0
-        for _ in self.stream(x for _ in range(num_microbatches)):
+        last = None
+        for out in self.stream(x for _ in range(num_microbatches)):
+            last = out
             n += 1
+        # A true completion barrier: device program order guarantees the
+        # last output retires after every earlier stage execution.
+        hard_sync(last)
         dt = time.perf_counter() - t0
         batch = int(x.shape[0]) if hasattr(x, "shape") and x.ndim > 0 else 1
         return {

@@ -1,0 +1,127 @@
+"""SPMD circular pipeline: shard_map + lax.ppermute over a "stage" mesh
+axis.
+
+This is the fully-compiled TPU analogue of the reference's streaming
+pipeline (SURVEY.md §3.3): where the reference overlaps stages with
+per-node recv/compute/send threads over TCP (reference
+src/node.py:97-133), here ONE XLA program runs on every core; each step
+every core applies its stage to its current activation and
+`lax.ppermute` rotates activations one hop along the ring — the
+transfer is an ICI collective the compiler schedules to overlap with
+compute. M microbatches drain in M + S - 1 steps (the classic
+warm-up/drain bubble).
+
+Requires homogeneous stages (same activation shape/dtype per hop and
+identically-structured per-stage params stacked on a leading axis) —
+the transformer-encoder case. Heterogeneous CNN chains use
+defer_tpu.parallel.pipeline.Pipeline instead.
+
+Composes with a "data" mesh axis (microbatch batch-dim sharding) and a
+"model" mesh axis (Megatron tensor parallelism inside the stage fn, see
+defer_tpu/parallel/transformer_stack.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+
+def make_spmd_pipeline(
+    mesh: Mesh,
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    param_specs: Any,
+    *,
+    stage_axis: str = "stage",
+    data_axis: str | None = None,
+) -> Callable[[Any, jax.Array], jax.Array]:
+    """Build the pipelined step function.
+
+    Args:
+      mesh: mesh containing `stage_axis` (and optionally data/model axes).
+      stage_fn: (stage-local params, activation [B, ...]) -> activation of
+        the SAME shape/dtype; runs inside shard_map, so it may use
+        collectives over other mesh axes (e.g. psum over "model").
+      param_specs: pytree of PartitionSpecs for the stacked stage params
+        (leading axis must be sharded over `stage_axis`).
+      data_axis: mesh axis to shard the microbatch batch dim over.
+
+    Returns:
+      run(stacked_params, xs): xs [M, B, ...] -> ys [M, B, ...], jittable.
+    """
+    num_stages = mesh.shape[stage_axis]
+    shift = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+
+    def pipelined(params_local, xs_local):
+        # shard_map keeps sharded axes as size-1 local dims; strip the
+        # stage axis so stage_fn sees clean per-stage params.
+        params_local = jax.tree_util.tree_map(
+            lambda a, s: a[0] if tuple(s) and tuple(s)[0] == stage_axis else a,
+            params_local,
+            param_specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        num_mb = xs_local.shape[0]
+        stage_id = lax.axis_index(stage_axis)
+        steps = num_mb + num_stages - 1
+        # The carry becomes device-varying after the first ppermute;
+        # mark the initial value as varying so scan's types line up.
+        buf = lax.pcast(
+            jnp.zeros_like(xs_local[0]), (stage_axis,), to="varying"
+        )
+
+        def step(carry, t):
+            # Stage 0 injects microbatch t; everyone else consumes the
+            # activation its left neighbour pushed last step.
+            x_t = xs_local[jnp.minimum(t, num_mb - 1)]
+            inp = jnp.where(stage_id == 0, x_t, carry)
+            out = stage_fn(params_local, inp)
+            return lax.ppermute(out, stage_axis, shift), out
+
+        _, emits = lax.scan(step, buf, jnp.arange(steps))
+        # Every device returns its per-step outputs; only the last
+        # stage's tail is meaningful and the wrapper slices exactly that
+        # shard — no output collective needed.
+        return emits[None]
+
+    in_specs = (param_specs, P(None, data_axis))
+    out_specs = P(stage_axis, None, data_axis)
+    mapped = jax.shard_map(
+        pipelined, mesh=mesh, in_specs=in_specs, out_specs=out_specs
+    )
+
+    def run(stacked_params, xs):
+        emits = mapped(stacked_params, xs)  # [S, M+S-1, B, ...]
+        return emits[-1, num_stages - 1 :]
+
+    return run
+
+
+def stack_for_stages(params: Any, num_stages: int) -> Any:
+    """Reshape leading [L, ...] leaves to [S, L // S, ...] so the layer
+    axis can be sharded over the stage axis."""
+
+    def reshape(leaf):
+        L = leaf.shape[0]
+        if L % num_stages:
+            raise ValueError(
+                f"layer count {L} not divisible by {num_stages} stages"
+            )
+        return leaf.reshape(num_stages, L // num_stages, *leaf.shape[1:])
+
+    return jax.tree_util.tree_map(reshape, params)
+
+
+def staged_specs(specs: Any, stage_axis: str = "stage") -> Any:
+    """Prepend the stage axis to per-layer specs (for stack_for_stages
+    output): P(a, b, ...) -> P(stage, a, b, ...)."""
+    return jax.tree_util.tree_map(
+        lambda s: P(stage_axis, *tuple(s)),
+        specs,
+        is_leaf=lambda s: isinstance(s, P),
+    )

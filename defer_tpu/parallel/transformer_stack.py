@@ -1,0 +1,164 @@
+"""Homogeneous transformer-encoder stack with Megatron-style tensor
+parallelism, for the SPMD pipeline.
+
+The reference never needed this (its zoo is CNNs shipped whole to CPU
+nodes), but BERT-base encoder inference is in its benchmark config list
+(BASELINE.json "configs": "BERT-base encoder inference ... transformer
+stages"). On TPU the idiomatic layout is: encoder blocks stacked on a
+leading layer axis, layer axis sharded over the "stage" mesh axis
+(pipeline), weight matrices sharded over a "model" mesh axis (tensor
+parallel, partial-sum reductions via psum over ICI), batch sharded over
+"data".
+
+Q/K/V projections are separate [D, D] matrices (not a fused [D, 3D]):
+under column sharding each tp shard then holds a contiguous head group
+of each of q, k, v, so attention is purely local and only the out/ffn
+row-parallel matmuls need a psum.
+
+All parameters are plain pytrees of arrays with a leading [L] layer
+axis; `stack_specs` gives the matching PartitionSpecs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from defer_tpu.ops.attention import multi_head_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    num_layers: int = 12
+    dim: int = 768
+    num_heads: int = 12
+    ffn_dim: int = 3072
+    vocab_size: int = 30522
+    max_len: int = 512
+    layer_norm_eps: float = 1e-12
+
+
+def init_stack(
+    rng: jax.Array, cfg: TransformerConfig, dtype: Any = jnp.float32
+) -> dict:
+    """Parameters for L stacked encoder blocks, leading axis = layer."""
+    L, D, F = cfg.num_layers, cfg.dim, cfg.ffn_dim
+    ks = jax.random.split(rng, 8)
+    s = D**-0.5
+    return {
+        "wq": jax.random.normal(ks[0], (L, D, D), dtype) * s,
+        "wk": jax.random.normal(ks[1], (L, D, D), dtype) * s,
+        "wv": jax.random.normal(ks[2], (L, D, D), dtype) * s,
+        "bq": jnp.zeros((L, D), dtype),
+        "bk": jnp.zeros((L, D), dtype),
+        "bv": jnp.zeros((L, D), dtype),
+        "wo": jax.random.normal(ks[3], (L, D, D), dtype) * s,
+        "bo": jnp.zeros((L, D), dtype),
+        "w1": jax.random.normal(ks[4], (L, D, F), dtype) * s,
+        "b1": jnp.zeros((L, F), dtype),
+        "w2": jax.random.normal(ks[5], (L, F, D), dtype) * (F**-0.5),
+        "b2": jnp.zeros((L, D), dtype),
+        "ln1_scale": jnp.ones((L, D), dtype),
+        "ln1_bias": jnp.zeros((L, D), dtype),
+        "ln2_scale": jnp.ones((L, D), dtype),
+        "ln2_bias": jnp.zeros((L, D), dtype),
+    }
+
+
+def stack_specs(
+    stage_axis: str | None = "stage", tp_axis: str | None = None
+) -> dict:
+    """PartitionSpecs matching init_stack: layer axis -> stage axis;
+    q/k/v/ffn-in column-parallel, out/ffn-out row-parallel over tp."""
+    st, tp = stage_axis, tp_axis
+    return {
+        "wq": P(st, None, tp),
+        "wk": P(st, None, tp),
+        "wv": P(st, None, tp),
+        "bq": P(st, tp),
+        "bk": P(st, tp),
+        "bv": P(st, tp),
+        "w1": P(st, None, tp),
+        "b1": P(st, tp),
+        "wo": P(st, tp, None),
+        "bo": P(st, None),
+        "w2": P(st, tp, None),
+        "b2": P(st, None),
+        "ln1_scale": P(st, None),
+        "ln1_bias": P(st, None),
+        "ln2_scale": P(st, None),
+        "ln2_bias": P(st, None),
+    }
+
+
+def _layer_norm(x, scale, bias, eps):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    out = (xf - mean) * lax.rsqrt(var + eps)
+    return (out * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(
+        x.dtype
+    )
+
+
+def block_apply(
+    p: dict,
+    x: jax.Array,
+    cfg: TransformerConfig,
+    *,
+    tp_axis: str | None = None,
+) -> jax.Array:
+    """One post-LN encoder block on (B, S, D); params have no layer axis.
+
+    Under shard_map with tp_axis set, the projections arrive
+    column-sharded (local output features = one head group) and wo/w2
+    row-sharded: local matmuls produce partial sums reduced with psum
+    over the tp axis — the Megatron pattern, collectives on ICI.
+    """
+    dt = x.dtype
+    tp_size = 1 if tp_axis is None else lax.axis_size(tp_axis)
+    local_heads = cfg.num_heads // tp_size
+
+    q = x @ p["wq"].astype(dt) + p["bq"].astype(dt)
+    k = x @ p["wk"].astype(dt) + p["bk"].astype(dt)
+    v = x @ p["wv"].astype(dt) + p["bv"].astype(dt)
+    attn = multi_head_attention(
+        q, k, v, num_heads=local_heads, use_pallas="auto"
+    )
+    attn = attn @ p["wo"].astype(dt)
+    if tp_axis is not None:
+        attn = lax.psum(attn, tp_axis)
+    attn = attn + p["bo"].astype(dt)
+    x = _layer_norm(
+        x + attn, p["ln1_scale"], p["ln1_bias"], cfg.layer_norm_eps
+    )
+
+    h = x @ p["w1"].astype(dt) + p["b1"].astype(dt)
+    h = jax.nn.gelu(h)
+    h = h @ p["w2"].astype(dt)
+    if tp_axis is not None:
+        h = lax.psum(h, tp_axis)
+    h = h + p["b2"].astype(dt)
+    return _layer_norm(x + h, p["ln2_scale"], p["ln2_bias"], cfg.layer_norm_eps)
+
+
+def layers_apply(
+    stacked: dict,
+    x: jax.Array,
+    cfg: TransformerConfig,
+    *,
+    tp_axis: str | None = None,
+) -> jax.Array:
+    """Apply a [Llocal, ...]-stacked group of blocks via lax.scan (one
+    compiled block body regardless of depth — compiler-friendly)."""
+
+    def body(h, p_one):
+        return block_apply(p_one, h, cfg, tp_axis=tp_axis), None
+
+    out, _ = lax.scan(body, x, stacked)
+    return out

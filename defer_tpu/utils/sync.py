@@ -1,0 +1,59 @@
+"""True device synchronization.
+
+On some PJRT transports (e.g. the tunneled single-chip dev setup),
+completion *notification* lags actual execution by tens of ms per array:
+`block_until_ready()` / `is_ready()` are unreliable or slow to flip,
+which silently turns throughput numbers into dispatch-rate numbers — or
+throttles a consume loop to the notification latency. Fetching data is
+the one fast, honest barrier: a host read of an output element can only
+return after its producer ran, so we fetch a single trailing element —
+one tiny transfer, not the full output.
+
+Design consequence for hot loops (see Pipeline.stream): never wait
+per-item; sync once per window on one array, and retire the whole
+prefix — device program order guarantees everything enqueued before the
+synced item has also completed.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as np
+
+
+def hard_sync(*arrays: jax.Array) -> None:
+    """Block until every given array's computation has truly completed
+    (fetch one element as a ground-truth barrier)."""
+    for arr in arrays:
+        if getattr(arr, "ndim", 0) > 0 and arr.size > 1:
+            np.asarray(arr.ravel()[-1:])
+        else:
+            np.asarray(arr)
+
+
+def hard_sync_timeout(arr: jax.Array, timeout_s: float) -> bool:
+    """hard_sync with a deadline (the fetch runs in a helper thread).
+    Returns False on timeout — the caller decides how to fail. A fetch
+    error (e.g. an XLA runtime failure surfacing on the transfer) is
+    re-raised here, not swallowed. Used by the streaming drain so a
+    stuck stage trips the watchdog instead of hanging the host forever
+    (the reference hangs, see reference src/node.py:102-103)."""
+    done = threading.Event()
+    error: list[BaseException] = []
+
+    def fetch() -> None:
+        try:
+            hard_sync(arr)
+        except BaseException as e:  # noqa: BLE001 — relayed to caller
+            error.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=fetch, daemon=True)
+    t.start()
+    finished = done.wait(timeout_s)
+    if finished and error:
+        raise error[0]
+    return finished
