@@ -18,7 +18,6 @@ instead of sockets, and the stream loop is the async pipeline.
 
 from __future__ import annotations
 
-import collections
 import queue
 import threading
 import time
@@ -35,7 +34,7 @@ from defer_tpu.parallel.mesh import pipeline_devices
 from defer_tpu.parallel.pipeline import Pipeline
 from defer_tpu.runtime.host_io import STOP, ProgressMonitor
 from defer_tpu.utils.logging import get_logger
-from defer_tpu.utils.sync import hard_sync, hard_sync_timeout
+from defer_tpu.utils.sync import Retirer, hard_sync, hard_sync_timeout
 
 log = get_logger(__name__)
 
@@ -123,48 +122,34 @@ class DEFER:
             model, partition_layers, params=params, rng=rng
         )
         monitor = ProgressMonitor(self.config.collective_timeout_s)
-        pending: "collections.deque[Any]" = collections.deque()
-        depth = self.config.max_inflight
         since_probe = 0
 
-        def emit() -> None:
-            monitor.completed()
-            output_stream.put(pending.popleft())
-
-        def barrier(arr: Any) -> None:
+        def watchdog_sync(arr: Any) -> None:
             # Fetch-based barrier with a deadline so a stuck stage trips
             # the watchdog instead of hanging forever (utils/sync.py).
             # A barrier may cover many microbatches; on timeout we only
-            # raise if not even the OLDEST pending item has finished —
-            # i.e. genuinely zero progress, matching collective_timeout_s
-            # semantics for slow-but-healthy pipelines.
+            # raise if the completed prefix stopped growing — genuinely
+            # zero progress, matching collective_timeout_s semantics for
+            # slow-but-healthy pipelines.
+            last_ready = -1
             while not hard_sync_timeout(
                 arr, self.config.collective_timeout_s
             ):
-                if not (pending and pending[0].is_ready()):
+                ready = retirer.ready_count()
+                if ready <= last_ready:
                     raise TimeoutError(
                         f"pipeline made no progress for "
                         f"{self.config.collective_timeout_s:.0f}s — a stage "
                         "or transfer is stuck"
                     )
-                while pending and pending[0].is_ready():
-                    emit()
+                last_ready = ready
 
-        def drain(block: bool) -> None:
-            # Emit whatever is known-finished; under depth pressure (or
-            # at end of stream) take one batched barrier that retires a
-            # whole prefix — never wait per item (see Pipeline.stream).
-            while pending and pending[0].is_ready():
-                emit()
-            if block and pending:
-                barrier(pending[-1])
-                while pending:
-                    emit()
-            elif len(pending) >= depth:
-                k = len(pending) // 2
-                barrier(pending[k])
-                for _ in range(k + 1):
-                    emit()
+        retirer = Retirer(self.config.max_inflight, sync=watchdog_sync)
+
+        def emit(items: Sequence[Any]) -> None:
+            for out in items:
+                monitor.completed()
+                output_stream.put(out)
 
         # Unlike Pipeline.stream (pull-based), this loop must keep
         # emitting results while the input queue idles — the reference's
@@ -174,14 +159,13 @@ class DEFER:
             try:
                 item = input_stream.get(timeout=0.05)
             except queue.Empty:
-                drain(block=False)
+                emit(retirer.collect())
                 monitor.check()
                 continue
             if item is None or item is STOP:
                 break
             monitor.submitted()
-            pending.append(pipe(item))
-            drain(block=False)
+            emit(retirer.add(pipe(item)))
             monitor.check()
             since_probe += 1
             if (
@@ -191,11 +175,11 @@ class DEFER:
                 # Synchronous per-stage latency probe; drain first so it
                 # doesn't interleave with (and distort) in-flight work.
                 since_probe = 0
-                drain(block=True)
+                emit(retirer.flush())
                 self.last_stage_latencies = pipe.probe_stage_latencies(
                     item, iters=3
                 )
-        drain(block=True)
+        emit(retirer.flush())
 
     def stop(self) -> None:
         self._stop.set()
@@ -232,17 +216,12 @@ def run_local_inference(
 
     count = 0
     t0 = time.perf_counter()
-    pending = []
+    retirer = Retirer(depth=16)
     while time.perf_counter() - t0 < duration_s:
-        pending.append(fn(params, x))
+        retirer.add(fn(params, x))
         count += 1
-        if len(pending) >= 16:
-            # Batched barrier: retire half the window with one fetch.
-            hard_sync(pending[7])
-            del pending[:8]
-    if pending:
-        # True completion barrier; device program order covers the rest.
-        hard_sync(pending[-1])
+    # True completion barrier; device program order covers the rest.
+    retirer.flush()
     dt = time.perf_counter() - t0
     return {
         "count": count,

@@ -1,0 +1,193 @@
+"""Pallas flash attention for TPU.
+
+The hot op of the transformer stack (SURVEY.md §5 notes the reference has
+no attention at all; BERT-base in BASELINE.json is served through the
+pipeline, and long-context support is first-class here). This kernel
+keeps the S×S score matrix out of HBM entirely: each (batch·head,
+q-block) grid cell streams K/V blocks through VMEM with the online
+softmax recurrence, so memory is O(S·D) instead of O(S²) and the two
+matmuls per block land on the MXU back-to-back.
+
+`multi_head_attention` (defer_tpu/ops/attention.py) dispatches here on
+TPU and falls back to the XLA einsum path elsewhere; tests run this
+kernel in interpreter mode on CPU against that reference.
+
+Differentiable: a custom VJP recomputes attention with the XLA
+reference implementation in the backward pass (flash-style
+rematerialization — nothing but q/k/v is saved for backward).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+
+# Finite stand-in for -inf: keeps fully-masked rows NaN-free in the
+# online-softmax recurrence (exp(MASK - MASK) would be NaN with -inf).
+_MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
+
+
+def _pick_block(s: int, preferred: int) -> int:
+    """Largest divisor of `s` that is <= preferred (>= 8 when possible)."""
+    b = min(preferred, s)
+    while b > 1 and s % b:
+        b -= 1
+    return b
+
+
+def _mha_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    *,
+    sm_scale: float,
+    causal: bool,
+    block_k: int,
+):
+    q = q_ref[0].astype(jnp.float32) * sm_scale  # (bq, d)
+    bq, d = q.shape
+    s_k = k_ref.shape[1]
+    q_start = pl.program_id(1) * bq
+
+    def body(i, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = lax.dot_general(
+            q,
+            k,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (bq, block_k)
+        if causal:
+            rows = q_start + lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0
+            )
+            cols = i * block_k + lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1
+            )
+            s = jnp.where(rows >= cols, s, _MASK_VALUE)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l * alpha + p.sum(axis=-1)
+        acc_new = acc * alpha[:, None] + lax.dot_general(
+            p,
+            v,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    num_k = s_k // block_k
+    if causal:
+        # Only blocks intersecting the causal triangle of this q block.
+        num_k = jnp.minimum(
+            num_k, (q_start + bq + block_k - 1) // block_k
+        )
+    init = (
+        jnp.full((bq,), _MASK_VALUE, jnp.float32),
+        jnp.zeros((bq,), jnp.float32),
+        jnp.zeros((bq, d), jnp.float32),
+    )
+    _, l, acc = lax.fori_loop(0, num_k, body, init)
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd_impl(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool,
+    interpret: bool,
+    block_q: int = 256,
+    block_k: int = 256,
+) -> jax.Array:
+    b, h, s_q, d = q.shape
+    s_k = k.shape[2]
+    if s_q < 8 or s_k < 8:
+        raise ValueError(f"sequence too short for the TPU kernel: {s_q}x{s_k}")
+    if causal and s_q != s_k:
+        raise ValueError("causal flash kernel requires s_q == s_k")
+    bq = _pick_block(s_q, block_q)
+    bk = _pick_block(s_k, block_k)
+    if bq < 8 or bk < 8:
+        raise ValueError(
+            f"no tile-friendly block split for seq lens {s_q}/{s_k}"
+        )
+    qf = q.reshape(b * h, s_q, d)
+    kf = k.reshape(b * h, s_k, d)
+    vf = v.reshape(b * h, s_k, d)
+    kernel = functools.partial(
+        _mha_kernel,
+        sm_scale=d**-0.5,
+        causal=causal,
+        block_k=bk,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, s_q // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, s_k, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, s_k, d), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, s_q, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _flash(causal: bool, interpret: bool, q, k, v):
+    return _flash_fwd_impl(q, k, v, causal=causal, interpret=interpret)
+
+
+def _flash_fwd(causal, interpret, q, k, v):
+    return _flash(causal, interpret, q, k, v), (q, k, v)
+
+
+def _flash_bwd(causal, interpret, res, g):
+    # Flash-style rematerialization: recompute attention with the XLA
+    # reference implementation and differentiate that. Saves only q/k/v
+    # for backward; XLA fuses the recompute into the backward matmuls.
+    from defer_tpu.ops.attention import attention_reference
+
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: attention_reference(q_, k_, v_, causal=causal),
+        q,
+        k,
+        v,
+    )
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash attention on (B, H, S, Dh) tensors; returns (B, H, S, Dh).
+
+    Raises ValueError for shapes without a tile-friendly block split —
+    `multi_head_attention` catches that in "auto" mode and falls back to
+    the XLA path.
+    """
+    if q.ndim != 4:
+        raise ValueError(f"expected (B, H, S, Dh), got {q.shape}")
+    return _flash(causal, interpret, q, k, v)

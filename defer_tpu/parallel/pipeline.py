@@ -22,7 +22,6 @@ cap on in-flight microbatches enforced by blocking on the oldest result.
 
 from __future__ import annotations
 
-import collections
 import time
 from typing import Any, Iterable, Iterator, Sequence
 
@@ -33,7 +32,7 @@ from defer_tpu.config import DeferConfig
 from defer_tpu.graph.ir import Graph, GraphParams
 from defer_tpu.graph.partition import stage_params
 from defer_tpu.utils.logging import get_logger
-from defer_tpu.utils.sync import hard_sync
+from defer_tpu.utils.sync import Retirer, hard_sync
 
 log = get_logger(__name__)
 
@@ -120,25 +119,14 @@ class Pipeline:
         server, here a single loop over async dispatches.
         """
         depth = max_inflight or self.config.max_inflight
-        pending: collections.deque[jax.Array] = collections.deque()
+        retirer = Retirer(depth)
         for x in inputs:
-            pending.append(self(x))
-            # Opportunistically emit anything already known-finished.
-            while pending and pending[0].is_ready():
-                yield pending.popleft()
-            if len(pending) >= depth:
-                # Backpressure: one barrier on the middle of the window
-                # retires the whole prefix (device program order) — never
-                # wait per item; completion notification can cost ~ms
-                # each, a batched barrier amortizes it (utils/sync.py).
-                k = len(pending) // 2
-                hard_sync(pending[k])
-                for _ in range(k + 1):
-                    yield pending.popleft()
-        if pending:
-            hard_sync(pending[-1])
-            while pending:
-                yield pending.popleft()
+            # Backpressure: Retirer emits the known-ready prefix for
+            # free and, at depth, takes one batched barrier on the
+            # middle of the window — never waits per item; completion
+            # notification can cost ~ms each (utils/sync.py).
+            yield from retirer.add(self(x))
+        yield from retirer.flush()
 
     def warmup(self, x: Any) -> jax.Array:
         """Compile every stage (first XLA compile is slow; do it before

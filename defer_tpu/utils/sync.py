@@ -17,7 +17,9 @@ synced item has also completed.
 
 from __future__ import annotations
 
+import collections
 import threading
+from typing import Any, Callable
 
 import jax
 import numpy as np
@@ -33,6 +35,14 @@ def hard_sync(*arrays: jax.Array) -> None:
             np.asarray(arr)
 
 
+# One in-flight fetch per array: a timed-out hard_sync_timeout leaves its
+# fetch thread blocked until the array completes; a retry on the same
+# array must join that fetch, not spawn another thread doing the same
+# device-to-host transfer.
+_inflight_lock = threading.Lock()
+_inflight: dict[int, threading.Event] = {}
+
+
 def hard_sync_timeout(arr: jax.Array, timeout_s: float) -> bool:
     """hard_sync with a deadline (the fetch runs in a helper thread).
     Returns False on timeout — the caller decides how to fail. A fetch
@@ -40,20 +50,102 @@ def hard_sync_timeout(arr: jax.Array, timeout_s: float) -> bool:
     re-raised here, not swallowed. Used by the streaming drain so a
     stuck stage trips the watchdog instead of hanging the host forever
     (the reference hangs, see reference src/node.py:102-103)."""
-    done = threading.Event()
-    error: list[BaseException] = []
+    key = id(arr)
+    with _inflight_lock:
+        done = _inflight.get(key)
+        if done is None:
+            done = threading.Event()
+            done.error = None  # type: ignore[attr-defined]
+            _inflight[key] = done
 
-    def fetch() -> None:
-        try:
-            hard_sync(arr)
-        except BaseException as e:  # noqa: BLE001 — relayed to caller
-            error.append(e)
-        finally:
-            done.set()
+            def fetch() -> None:
+                try:
+                    hard_sync(arr)
+                except BaseException as e:  # noqa: BLE001 — relayed below
+                    done.error = e  # type: ignore[attr-defined]
+                finally:
+                    with _inflight_lock:
+                        _inflight.pop(key, None)
+                    done.set()
 
-    t = threading.Thread(target=fetch, daemon=True)
-    t.start()
+            threading.Thread(target=fetch, daemon=True).start()
     finished = done.wait(timeout_s)
-    if finished and error:
-        raise error[0]
+    err = getattr(done, "error", None)
+    if finished and err is not None:
+        raise err
     return finished
+
+
+class Retirer:
+    """Windowed retire of async results, in order.
+
+    The one implementation of the batched-barrier pattern every hot loop
+    here uses (Pipeline.stream, DEFER.run_defer, run_local_inference):
+    emit the known-ready prefix for free; under depth pressure take ONE
+    barrier on the middle of the window and retire the whole prefix —
+    device program order guarantees everything enqueued before the
+    synced item has completed (see module docstring). Never wait
+    per-item.
+
+    `sync` is the barrier (default `hard_sync`); a caller may supply a
+    timeout-aware one (DEFER's watchdog barrier). It must not mutate the
+    queue — retirement is identity-based on the synced item, so a
+    barrier that covers more (or fewer) items than the caller guessed
+    still retires exactly the completed prefix.
+    """
+
+    def __init__(
+        self,
+        depth: int,
+        sync: Callable[[Any], None] = hard_sync,
+    ):
+        self.depth = depth
+        self.sync = sync
+        self.pending: collections.deque[Any] = collections.deque()
+
+    def __len__(self) -> int:
+        return len(self.pending)
+
+    def ready_count(self) -> int:
+        """Length of the known-completed prefix."""
+        n = 0
+        for item in self.pending:
+            if not item.is_ready():
+                break
+            n += 1
+        return n
+
+    def _pop_through(self, target: Any) -> list[Any]:
+        out = []
+        while self.pending:
+            done = self.pending[0] is target
+            out.append(self.pending.popleft())
+            if done:
+                break
+        return out
+
+    def add(self, item: Any) -> list[Any]:
+        """Enqueue one async result; returns items retired by pressure
+        (ready prefix plus, at depth, one batched-barrier prefix)."""
+        self.pending.append(item)
+        out = self.collect()
+        if len(self.pending) >= self.depth:
+            target = self.pending[len(self.pending) // 2]
+            self.sync(target)
+            out.extend(self._pop_through(target))
+        return out
+
+    def collect(self) -> list[Any]:
+        """Retire the known-ready prefix without blocking."""
+        out = []
+        while self.pending and self.pending[0].is_ready():
+            out.append(self.pending.popleft())
+        return out
+
+    def flush(self) -> list[Any]:
+        """Barrier on the newest item and retire everything."""
+        if self.pending:
+            self.sync(self.pending[-1])
+        out = list(self.pending)
+        self.pending.clear()
+        return out

@@ -1,0 +1,75 @@
+"""Pallas flash attention vs the XLA reference, in interpreter mode.
+
+The kernel itself targets TPU; `interpret=True` runs the exact same
+Pallas program on the CPU test mesh so CI needs no hardware (SURVEY.md
+§4's test strategy).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from defer_tpu.ops.attention import attention_reference, multi_head_attention
+from defer_tpu.ops.pallas_attention import flash_attention
+
+
+def _qkv(shape, seed=0, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.key(seed), 3)
+    return tuple(jax.random.normal(k, shape, dtype) for k in ks)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize(
+    "shape",
+    [
+        (1, 2, 128, 64),   # one k block
+        (2, 4, 512, 64),   # multiple k blocks
+        (1, 2, 384, 32),   # non-power-of-two seq -> odd block split
+    ],
+)
+def test_flash_matches_reference(shape, causal):
+    q, k, v = _qkv(shape)
+    got = flash_attention(q, k, v, causal=causal, interpret=True)
+    want = attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_flash_bf16_inputs():
+    q, k, v = _qkv((1, 2, 256, 64), dtype=jnp.bfloat16)
+    got = flash_attention(q, k, v, interpret=True)
+    want = attention_reference(q, k, v)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        got.astype(np.float32), want.astype(np.float32), atol=2e-2
+    )
+
+
+def test_flash_grad_matches_reference():
+    q, k, v = _qkv((1, 2, 128, 32), seed=3)
+
+    def loss_flash(q, k, v):
+        return flash_attention(q, k, v, causal=True, interpret=True).sum()
+
+    def loss_ref(q, k, v):
+        return attention_reference(q, k, v, causal=True).sum()
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(a, b, atol=2e-5, rtol=2e-5)
+
+
+def test_flash_rejects_short_sequences():
+    q, k, v = _qkv((1, 1, 4, 16))
+    with pytest.raises(ValueError):
+        flash_attention(q, k, v, interpret=True)
+
+
+def test_mha_auto_falls_back_off_tpu():
+    # On the CPU test platform "auto" must take the XLA path and agree
+    # with the reference exactly.
+    b, s, d, h = 2, 64, 32, 4
+    q, k, v = _qkv((b, s, d), seed=5)
+    out = multi_head_attention(q, k, v, num_heads=h)
+    assert out.shape == (b, s, d)
