@@ -129,10 +129,28 @@ class SpmdBert:
     mesh: Mesh
     cfg: TransformerConfig
     compute_dtype: Any = jnp.bfloat16
+    sp_strategy: str = "ring"
 
     def __post_init__(self):
+        if "stage" not in self.mesh.axis_names:
+            raise ValueError(
+                "SpmdBert needs a 'stage' mesh axis (size 1 is fine): "
+                f"got axes {self.mesh.axis_names}"
+            )
         self.num_stages = self.mesh.shape.get("stage", 1)
         self.tp_axis = "model" if self.mesh.shape.get("model", 1) > 1 else None
+        self.sp_axis = "seq" if self.mesh.shape.get("seq", 1) > 1 else None
+        ep = self.mesh.shape.get("expert", 1)
+        self.ep_axis = "expert" if ep > 1 else None
+        if self.cfg.num_experts and self.cfg.num_experts % ep:
+            raise ValueError(
+                f"{self.cfg.num_experts} experts not divisible by the "
+                f"expert axis size {ep}"
+            )
+        if ep > 1 and not self.cfg.num_experts:
+            raise ValueError(
+                "mesh has an expert axis but cfg.num_experts == 0"
+            )
         if self.cfg.num_layers % self.num_stages:
             raise ValueError(
                 f"{self.cfg.num_layers} layers not divisible by "
@@ -147,13 +165,23 @@ class SpmdBert:
                 "with the wrong head grouping"
             )
 
+    def _stack_param_specs(self):
+        return staged_specs(
+            stack_specs(
+                None,
+                self.tp_axis,
+                ep_axis=self.ep_axis,
+                moe=bool(self.cfg.num_experts),
+            ),
+            "stage",
+        )
+
     def _stack_shardings(self):
         from jax.sharding import NamedSharding
 
-        specs = staged_specs(stack_specs(None, self.tp_axis), "stage")
         return jax.tree_util.tree_map(
             lambda s: NamedSharding(self.mesh, s),
-            specs,
+            self._stack_param_specs(),
             is_leaf=lambda s: isinstance(s, P),
         )
 
@@ -193,14 +221,23 @@ class SpmdBert:
         cd = self.compute_dtype
 
         def stage_fn(stack_local, x):
-            return layers_apply(stack_local, x, cfg, tp_axis=self.tp_axis)
+            return layers_apply(
+                stack_local,
+                x,
+                cfg,
+                tp_axis=self.tp_axis,
+                sp_axis=self.sp_axis,
+                sp_strategy=self.sp_strategy,
+                ep_axis=self.ep_axis,
+            )
 
         pipe = make_spmd_pipeline(
             self.mesh,
             stage_fn,
-            staged_specs(stack_specs(None, self.tp_axis), "stage"),
+            self._stack_param_specs(),
             stage_axis="stage",
             data_axis="data" if self.mesh.shape.get("data", 1) > 1 else None,
+            seq_axis=self.sp_axis,
         )
 
         def step(params, ids):

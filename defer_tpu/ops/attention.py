@@ -60,13 +60,34 @@ def multi_head_attention(
     bias: jax.Array | None = None,
     causal: bool = False,
     use_pallas: Any = "auto",
+    sp_axis: str | None = None,
+    sp_strategy: str = "ring",
 ) -> jax.Array:
     """Attention on (B, S, D) projections; returns (B, S, D).
 
     use_pallas: True / False / "auto" (pallas iff running on TPU and the
     shape is tile-friendly).
+
+    sp_axis: mesh axis name for sequence parallelism — S is then the
+    LOCAL sequence shard and attention runs ring / Ulysses over that
+    axis (defer_tpu/parallel/sequence.py). Only valid inside shard_map.
     """
     qh, kh, vh = (_split_heads(t, num_heads) for t in (q, k, v))
+    if sp_axis is not None:
+        if bias is not None:
+            raise NotImplementedError(
+                "bias is not supported under sequence parallelism"
+            )
+        from defer_tpu.parallel.sequence import sequence_attention
+
+        return _merge_heads(
+            sequence_attention(
+                qh, kh, vh,
+                axis_name=sp_axis,
+                strategy=sp_strategy,
+                causal=causal,
+            )
+        )
     want_pallas = (
         use_pallas is True or (use_pallas == "auto" and _pallas_available())
     )

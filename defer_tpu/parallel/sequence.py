@@ -1,0 +1,189 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+Long-context support is first-class in this framework even though the
+reference has none (SURVEY.md §5: "no attention, no sequence axis
+anywhere in src/"). Two standard strategies, both pure collectives over
+a `seq` mesh axis so XLA schedules the transfers on ICI:
+
+* **Ring attention** (`ring_attention`): Q stays resident; K/V blocks
+  rotate one hop per step with `lax.ppermute` while each device folds
+  the visiting block into a streaming-softmax accumulator (the same
+  online recurrence as the Pallas flash kernel, lifted across chips).
+  Memory per device is O(S_local · D); the S×S score matrix never
+  exists. Compute for step t overlaps the ppermute for step t+1.
+
+* **Ulysses** (`ulysses_attention`): two `lax.all_to_all`s re-shard
+  from sequence-sharded to head-sharded and back, so attention itself
+  runs unsharded on a head subset. Cheaper collectives for moderate S;
+  requires num_heads % seq_axis_size == 0.
+
+Both operate on already-projected (B, H, S_local, Dh) tensors inside
+`shard_map` and compose with tensor parallelism (heads are first split
+over the tp axis, then handled per-strategy over the seq axis).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from defer_tpu.ops.pallas_attention import _MASK_VALUE
+
+
+def _block_scores(q, k, scale):
+    return (
+        lax.dot_general(
+            q,
+            k,
+            (((3,), (3,)), ((0, 1), (0, 1))),
+            preferred_element_type=jnp.float32,
+        )
+        * scale
+    )  # (B, H, Sq, Sk)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    causal: bool = False,
+) -> jax.Array:
+    """Ring attention on (B, H, S_local, Dh) shards, inside shard_map.
+
+    The global sequence is the concatenation of every device's shard in
+    axis-index order. Returns the local shard of the attention output.
+    """
+    n = lax.axis_size(axis_name)  # static: mesh shape is trace-time
+    idx = lax.axis_index(axis_name)
+    # K/V travel backward around the ring (device i receives from i+1),
+    # so after t steps device i holds the block of device (i + t) % n.
+    perm = [(i, (i - 1) % n) for i in range(n)]
+    s_local = q.shape[2]
+    scale = q.shape[-1] ** -0.5
+    qf = q.astype(jnp.float32)
+
+    # Fresh zeros would be device-invariant; the accumulators must be
+    # varying over every manual axis q is varying over (seq here, plus
+    # e.g. the pipeline's stage axis when nested) — deriving them from
+    # qf inherits exactly that type, and XLA folds the arithmetic away.
+    zero_row = qf.sum(axis=-1) * 0.0  # (B, H, S_local) f32
+    m, l, acc = zero_row + _MASK_VALUE, zero_row, qf * 0.0
+    k_cur, v_cur = k, v
+    # Unrolled over the (static, small) ring size so the last iteration
+    # skips its rotation — a fori_loop body would pay one wasted ICI hop
+    # of the full K/V shards per attention call. XLA overlaps each
+    # ppermute with the previous block's matmuls.
+    for t in range(n):
+        src = (idx + t) % n  # global block index k_cur/v_cur came from
+        s = _block_scores(qf, k_cur.astype(jnp.float32), scale)
+        if causal:
+            q_pos = idx * s_local + lax.broadcasted_iota(
+                jnp.int32, s.shape, 2
+            )
+            k_pos = src * s_local + lax.broadcasted_iota(
+                jnp.int32, s.shape, 3
+            )
+            s = jnp.where(q_pos >= k_pos, s, _MASK_VALUE)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l = l * alpha + p.sum(axis=-1)
+        acc = acc * alpha[..., None] + lax.dot_general(
+            p,
+            v_cur.astype(jnp.float32),
+            (((3,), (2,)), ((0, 1), (0, 1))),
+            preferred_element_type=jnp.float32,
+        )
+        m = m_new
+        if t < n - 1:
+            k_cur = lax.ppermute(k_cur, axis_name, perm)
+            v_cur = lax.ppermute(v_cur, axis_name, perm)
+    return (acc / l[..., None]).astype(q.dtype)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    causal: bool = False,
+) -> jax.Array:
+    """Ulysses attention on (B, H, S_local, Dh) shards, inside shard_map.
+
+    all_to_all to (B, H/n, S_global, Dh), plain attention on the full
+    sequence for the local head group, all_to_all back.
+    """
+    from defer_tpu.ops.attention import attention_reference
+
+    n = lax.axis_size(axis_name)
+    if q.shape[1] % n:
+        raise ValueError(
+            f"num local heads {q.shape[1]} must divide by seq axis size {n}"
+        )
+    def to_heads(x):
+        return lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    out = attention_reference(qh, kh, vh, causal=causal)
+    return lax.all_to_all(
+        out, axis_name, split_axis=2, concat_axis=1, tiled=True
+    )
+
+
+def sequence_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str | None,
+    strategy: str = "ring",
+    causal: bool = False,
+) -> jax.Array:
+    """Dispatch on (B, H, S_local, Dh): ring / ulysses / local."""
+    if axis_name is None:
+        from defer_tpu.ops.attention import attention_reference
+
+        return attention_reference(q, k, v, causal=causal)
+    if strategy == "ring":
+        return ring_attention(q, k, v, axis_name=axis_name, causal=causal)
+    if strategy == "ulysses":
+        return ulysses_attention(q, k, v, axis_name=axis_name, causal=causal)
+    raise ValueError(f"unknown sequence-parallel strategy {strategy!r}")
+
+
+def make_sharded_attention(
+    mesh: Mesh,
+    *,
+    seq_axis: str = "seq",
+    strategy: str = "ring",
+    causal: bool = False,
+):
+    """Jittable (q, k, v) -> out on GLOBAL (B, H, S, Dh) tensors with S
+    sharded over `seq_axis` — the standalone entry point (the
+    transformer stack calls `sequence_attention` directly inside its own
+    shard_map instead)."""
+    spec = P(None, None, seq_axis, None)
+
+    @jax.jit
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    def attn(q, k, v):
+        return sequence_attention(
+            q, k, v, axis_name=seq_axis, strategy=strategy, causal=causal
+        )
+
+    return attn

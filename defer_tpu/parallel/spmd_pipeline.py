@@ -39,17 +39,23 @@ def make_spmd_pipeline(
     *,
     stage_axis: str = "stage",
     data_axis: str | None = None,
+    seq_axis: str | None = None,
 ) -> Callable[[Any, jax.Array], jax.Array]:
     """Build the pipelined step function.
 
     Args:
-      mesh: mesh containing `stage_axis` (and optionally data/model axes).
+      mesh: mesh containing `stage_axis` (and optionally data/model/seq
+        axes).
       stage_fn: (stage-local params, activation [B, ...]) -> activation of
         the SAME shape/dtype; runs inside shard_map, so it may use
-        collectives over other mesh axes (e.g. psum over "model").
+        collectives over other mesh axes (e.g. psum over "model", ring
+        attention over "seq").
       param_specs: pytree of PartitionSpecs for the stacked stage params
         (leading axis must be sharded over `stage_axis`).
       data_axis: mesh axis to shard the microbatch batch dim over.
+      seq_axis: mesh axis to shard the activation's axis 1 after batch
+        (the sequence dim of [M, B, S, ...]) over — sequence
+        parallelism; stage_fn then sees the local shard.
 
     Returns:
       run(stacked_params, xs): xs [M, B, ...] -> ys [M, B, ...], jittable.
@@ -89,8 +95,9 @@ def make_spmd_pipeline(
         # shard — no output collective needed.
         return emits[None]
 
-    in_specs = (param_specs, P(None, data_axis))
-    out_specs = P(stage_axis, None, data_axis)
+    act_axes = (data_axis,) if seq_axis is None else (data_axis, seq_axis)
+    in_specs = (param_specs, P(None, *act_axes))
+    out_specs = P(stage_axis, None, *act_axes)
     mapped = jax.shard_map(
         pipelined, mesh=mesh, in_specs=in_specs, out_specs=out_specs
     )

@@ -1,0 +1,102 @@
+"""Distributed training step over the SPMD pipeline.
+
+The reference is inference-only (SURVEY.md §5: "nothing to checkpoint",
+no training anywhere), but this framework treats training as a
+first-class capability of the same SPMD machinery: ONE jitted step
+computes loss and gradients *through* the ppermute pipeline (pp), the
+Megatron tensor-parallel matmuls (tp), ring/Ulysses attention (sp), the
+expert-parallel MoE FFN (ep) and the batch sharding (dp), then applies
+an optax update — every collective inserted by XLA on ICI.
+
+Gradients flow backward through `lax.ppermute` as the reverse permute,
+so pipeline-parallel backprop needs no hand-written schedule: the scan
+transpose reverses the warm-up/drain automatically.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from defer_tpu.models.bert import SpmdBert
+
+
+class TrainState(NamedTuple):
+    params: Any
+    opt_state: Any
+    step: jax.Array
+
+
+def make_classifier_params(
+    rng: jax.Array, sb: SpmdBert, num_classes: int
+) -> dict:
+    """Replicated classification head on the pooled output."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    rep = NamedSharding(sb.mesh, P())
+    w = jax.random.normal(rng, (sb.cfg.dim, num_classes)) * sb.cfg.dim**-0.5
+    return {
+        "cls_w": jax.device_put(w, rep),
+        "cls_b": jax.device_put(jnp.zeros((num_classes,)), rep),
+    }
+
+
+def make_train_step(
+    sb: SpmdBert,
+    optimizer: optax.GradientTransformation,
+    *,
+    num_classes: int,
+) -> tuple[
+    Callable[[jax.Array, Any], TrainState],
+    Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, jax.Array]],
+]:
+    """Returns (init_state, train_step).
+
+    train_step(state, ids [M, B, S], labels [M, B]) -> (state, loss):
+    microbatches stream through the pipeline, per-microbatch CLS
+    classification losses are averaged, and one optimizer update is
+    applied — i.e. M microbatches of gradient accumulation happen
+    *inside* the pipelined program, which is exactly what keeps the
+    pipeline bubble amortized during training.
+    """
+    forward = sb.make_step()
+
+    def loss_fn(params, ids, labels):
+        pooled = forward(params, ids)  # [M, B, D]
+        logits = (
+            pooled.astype(jnp.float32) @ params["cls_w"] + params["cls_b"]
+        )
+        losses = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        )
+        return losses.mean()
+
+    def init_state(rng: jax.Array, extra_params: dict | None = None):
+        params = {**sb.init(rng)}
+        params.update(
+            make_classifier_params(
+                jax.random.fold_in(rng, 17), sb, num_classes
+            )
+        )
+        if extra_params:
+            params.update(extra_params)
+        return TrainState(
+            params=params,
+            opt_state=optimizer.init(params),
+            step=jnp.zeros((), jnp.int32),
+        )
+
+    @jax.jit
+    def train_step(state: TrainState, ids, labels):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, ids, labels)
+        updates, opt_state = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    return init_state, train_step

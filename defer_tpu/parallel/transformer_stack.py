@@ -41,6 +41,9 @@ class TransformerConfig:
     vocab_size: int = 30522
     max_len: int = 512
     layer_norm_eps: float = 1e-12
+    # > 0 switches every block's FFN to a top-1-routed mixture of
+    # experts (expert-parallel over an "expert" mesh axis).
+    num_experts: int = 0
 
 
 def init_stack(
@@ -50,7 +53,7 @@ def init_stack(
     L, D, F = cfg.num_layers, cfg.dim, cfg.ffn_dim
     ks = jax.random.split(rng, 8)
     s = D**-0.5
-    return {
+    p = {
         "wq": jax.random.normal(ks[0], (L, D, D), dtype) * s,
         "wk": jax.random.normal(ks[1], (L, D, D), dtype) * s,
         "wv": jax.random.normal(ks[2], (L, D, D), dtype) * s,
@@ -59,41 +62,132 @@ def init_stack(
         "bv": jnp.zeros((L, D), dtype),
         "wo": jax.random.normal(ks[3], (L, D, D), dtype) * s,
         "bo": jnp.zeros((L, D), dtype),
-        "w1": jax.random.normal(ks[4], (L, D, F), dtype) * s,
-        "b1": jnp.zeros((L, F), dtype),
-        "w2": jax.random.normal(ks[5], (L, F, D), dtype) * (F**-0.5),
-        "b2": jnp.zeros((L, D), dtype),
         "ln1_scale": jnp.ones((L, D), dtype),
         "ln1_bias": jnp.zeros((L, D), dtype),
         "ln2_scale": jnp.ones((L, D), dtype),
         "ln2_bias": jnp.zeros((L, D), dtype),
     }
+    if cfg.num_experts:
+        E = cfg.num_experts
+        p.update(
+            {
+                "router": jax.random.normal(ks[6], (L, D, E), dtype) * s,
+                "w1": jax.random.normal(ks[4], (L, E, D, F), dtype) * s,
+                "b1": jnp.zeros((L, E, F), dtype),
+                "w2": jax.random.normal(ks[5], (L, E, F, D), dtype)
+                * (F**-0.5),
+                "b2": jnp.zeros((L, E, D), dtype),
+            }
+        )
+    else:
+        p.update(
+            {
+                "w1": jax.random.normal(ks[4], (L, D, F), dtype) * s,
+                "b1": jnp.zeros((L, F), dtype),
+                "w2": jax.random.normal(ks[5], (L, F, D), dtype)
+                * (F**-0.5),
+                "b2": jnp.zeros((L, D), dtype),
+            }
+        )
+    return p
 
 
 def stack_specs(
-    stage_axis: str | None = "stage", tp_axis: str | None = None
+    stage_axis: str | None = "stage",
+    tp_axis: str | None = None,
+    *,
+    ep_axis: str | None = None,
+    moe: bool = False,
 ) -> dict:
     """PartitionSpecs matching init_stack: layer axis -> stage axis;
-    q/k/v/ffn-in column-parallel, out/ffn-out row-parallel over tp."""
-    st, tp = stage_axis, tp_axis
-    return {
+    q/k/v/ffn-in column-parallel, out/ffn-out row-parallel over tp; with
+    moe=True the expert axis of the FFN weights shards over ep_axis."""
+    st, tp, ep = stage_axis, tp_axis, ep_axis
+    p = {
         "wq": P(st, None, tp),
         "wk": P(st, None, tp),
         "wv": P(st, None, tp),
         "bq": P(st, tp),
         "bk": P(st, tp),
         "bv": P(st, tp),
-        "w1": P(st, None, tp),
-        "b1": P(st, tp),
         "wo": P(st, tp, None),
         "bo": P(st, None),
-        "w2": P(st, tp, None),
-        "b2": P(st, None),
         "ln1_scale": P(st, None),
         "ln1_bias": P(st, None),
         "ln2_scale": P(st, None),
         "ln2_bias": P(st, None),
     }
+    if moe:
+        p.update(
+            {
+                "router": P(st, None, None),
+                "w1": P(st, ep, None, tp),
+                "b1": P(st, ep, tp),
+                "w2": P(st, ep, tp, None),
+                "b2": P(st, ep, None),
+            }
+        )
+    else:
+        p.update(
+            {
+                "w1": P(st, None, tp),
+                "b1": P(st, tp),
+                "w2": P(st, tp, None),
+                "b2": P(st, None),
+            }
+        )
+    return p
+
+
+def moe_ffn(
+    p: dict,
+    x: jax.Array,
+    *,
+    tp_axis: str | None = None,
+    ep_axis: str | None = None,
+) -> jax.Array:
+    """Top-1 (switch-style) mixture-of-experts FFN on (B, S, D).
+
+    Expert parallelism by partition-of-experts: each device along
+    ep_axis holds E_local experts, computes them for every token, and
+    the top-1 dispatch mask zeroes the rest before a psum over ep
+    combines shards. Dense dispatch keeps shapes static (no capacity /
+    token dropping) — the XLA-friendly formulation; a capacity-based
+    all_to_all dispatch is the scaling path for large expert counts.
+
+    The router is replicated; routing probabilities are computed over
+    the GLOBAL expert count so results are identical for any ep layout.
+    """
+    dt = x.dtype
+    e_local = p["w1"].shape[0]
+    ep_idx = 0 if ep_axis is None else lax.axis_index(ep_axis)
+
+    logits = (x.astype(jnp.float32)) @ p["router"].astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)  # (B, S, E_global)
+    gate = probs.max(axis=-1)  # (B, S)
+    top = probs.argmax(axis=-1)  # (B, S)
+    global_ids = ep_idx * e_local + jnp.arange(e_local)
+    dispatch = (
+        (top[..., None] == global_ids) * gate[..., None]
+    ).astype(jnp.float32)  # (B, S, E_local)
+
+    h = (
+        jnp.einsum("bsd,edf->ebsf", x, p["w1"].astype(dt))
+        + p["b1"].astype(dt)[:, None, None, :]
+    )
+    h = jax.nn.gelu(h)
+    y = jnp.einsum("ebsf,efd->ebsd", h, p["w2"].astype(dt))
+    if tp_axis is not None:
+        # w1 column- / w2 row-sharded over tp: partial sums, as in the
+        # dense FFN.
+        y = lax.psum(y, tp_axis)
+    y = y + p["b2"].astype(dt)[:, None, None, :]
+    out = jnp.einsum(
+        "ebsd,bse->bsd", y.astype(jnp.float32), dispatch
+    )
+    if ep_axis is not None:
+        out = lax.psum(out, ep_axis)
+    return out.astype(dt)
 
 
 def _layer_norm(x, scale, bias, eps):
@@ -112,6 +206,9 @@ def block_apply(
     cfg: TransformerConfig,
     *,
     tp_axis: str | None = None,
+    sp_axis: str | None = None,
+    sp_strategy: str = "ring",
+    ep_axis: str | None = None,
 ) -> jax.Array:
     """One post-LN encoder block on (B, S, D); params have no layer axis.
 
@@ -119,6 +216,10 @@ def block_apply(
     column-sharded (local output features = one head group) and wo/w2
     row-sharded: local matmuls produce partial sums reduced with psum
     over the tp axis — the Megatron pattern, collectives on ICI.
+
+    With sp_axis set, S is the LOCAL sequence shard and attention runs
+    ring / Ulysses over that mesh axis (defer_tpu/parallel/sequence.py);
+    everything else in the block is per-token and needs no collective.
     """
     dt = x.dtype
     tp_size = 1 if tp_axis is None else lax.axis_size(tp_axis)
@@ -128,7 +229,13 @@ def block_apply(
     k = x @ p["wk"].astype(dt) + p["bk"].astype(dt)
     v = x @ p["wv"].astype(dt) + p["bv"].astype(dt)
     attn = multi_head_attention(
-        q, k, v, num_heads=local_heads, use_pallas="auto"
+        q,
+        k,
+        v,
+        num_heads=local_heads,
+        use_pallas="auto",
+        sp_axis=sp_axis,
+        sp_strategy=sp_strategy,
     )
     attn = attn @ p["wo"].astype(dt)
     if tp_axis is not None:
@@ -138,12 +245,15 @@ def block_apply(
         x + attn, p["ln1_scale"], p["ln1_bias"], cfg.layer_norm_eps
     )
 
-    h = x @ p["w1"].astype(dt) + p["b1"].astype(dt)
-    h = jax.nn.gelu(h)
-    h = h @ p["w2"].astype(dt)
-    if tp_axis is not None:
-        h = lax.psum(h, tp_axis)
-    h = h + p["b2"].astype(dt)
+    if "router" in p:
+        h = moe_ffn(p, x, tp_axis=tp_axis, ep_axis=ep_axis)
+    else:
+        h = x @ p["w1"].astype(dt) + p["b1"].astype(dt)
+        h = jax.nn.gelu(h)
+        h = h @ p["w2"].astype(dt)
+        if tp_axis is not None:
+            h = lax.psum(h, tp_axis)
+        h = h + p["b2"].astype(dt)
     return _layer_norm(x + h, p["ln2_scale"], p["ln2_bias"], cfg.layer_norm_eps)
 
 
@@ -153,12 +263,26 @@ def layers_apply(
     cfg: TransformerConfig,
     *,
     tp_axis: str | None = None,
+    sp_axis: str | None = None,
+    sp_strategy: str = "ring",
+    ep_axis: str | None = None,
 ) -> jax.Array:
     """Apply a [Llocal, ...]-stacked group of blocks via lax.scan (one
     compiled block body regardless of depth — compiler-friendly)."""
 
     def body(h, p_one):
-        return block_apply(p_one, h, cfg, tp_axis=tp_axis), None
+        return (
+            block_apply(
+                p_one,
+                h,
+                cfg,
+                tp_axis=tp_axis,
+                sp_axis=sp_axis,
+                sp_strategy=sp_strategy,
+                ep_axis=ep_axis,
+            ),
+            None,
+        )
 
     out, _ = lax.scan(body, x, stacked)
     return out

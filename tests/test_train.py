@@ -1,0 +1,102 @@
+"""Training through the SPMD pipeline (dp/pp/tp/sp/ep) and the
+expert-parallel MoE block, on the 8-virtual-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from defer_tpu.models.bert import SpmdBert
+from defer_tpu.parallel.mesh import make_mesh
+from defer_tpu.parallel.train import make_train_step
+from defer_tpu.parallel.transformer_stack import TransformerConfig
+
+
+def _cfg(**kw):
+    base = dict(
+        num_layers=4, dim=32, num_heads=4, ffn_dim=64, vocab_size=64,
+        max_len=32,
+    )
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def test_moe_expert_parallel_matches_reference(devices):
+    """Top-1 MoE with experts split over the expert axis == the same
+    model computed unsharded."""
+    cfg = _cfg(num_experts=4)
+    mesh = make_mesh({"stage": 2, "expert": 4}, devices)
+    sb = SpmdBert(mesh, cfg, compute_dtype=jnp.float32)
+    params = sb.init(jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (3, 2, 8), 0, cfg.vocab_size)
+    got = sb.make_step()(params, ids)
+    want = sb.reference_apply(params, ids)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-6
+    )
+
+
+def test_moe_rejects_mismatched_expert_axis(devices):
+    cfg = _cfg(num_experts=3)
+    mesh = make_mesh({"stage": 1, "expert": 2}, devices[:2])
+    with pytest.raises(ValueError, match="not divisible"):
+        SpmdBert(mesh, cfg)
+
+
+def _run_training(mesh, cfg, steps=12, num_mb=4, batch=2, seq=8):
+    sb = SpmdBert(mesh, cfg, compute_dtype=jnp.float32)
+    init_state, train_step = make_train_step(
+        sb, optax.adam(1e-2), num_classes=4
+    )
+    state = init_state(jax.random.key(0))
+    ids = jax.random.randint(
+        jax.random.key(1), (num_mb, batch, seq), 0, cfg.vocab_size
+    )
+    labels = jax.random.randint(jax.random.key(2), (num_mb, batch), 0, 4)
+    losses = []
+    for _ in range(steps):
+        state, loss = train_step(state, ids, labels)
+        losses.append(float(loss))
+    return losses
+
+
+def test_train_step_dp_pp_tp(devices):
+    mesh = make_mesh({"data": 2, "stage": 2, "model": 2}, devices)
+    losses = _run_training(mesh, _cfg())
+    assert np.isfinite(losses).all()
+    # Overfitting one tiny fixed batch with Adam must drive loss down.
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_train_step_pp_sp_ep(devices):
+    """Pipeline x ring-attention sequence parallel x expert parallel."""
+    mesh = make_mesh({"stage": 2, "seq": 2, "expert": 2}, devices)
+    losses = _run_training(mesh, _cfg(num_experts=2))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_train_loss_matches_reference_forward(devices):
+    """The pipelined training loss equals the loss computed from the
+    unpipelined reference forward on the same params."""
+    mesh = make_mesh({"stage": 4}, devices[:4])
+    cfg = _cfg()
+    sb = SpmdBert(mesh, cfg, compute_dtype=jnp.float32)
+    init_state, train_step = make_train_step(
+        sb, optax.sgd(0.0), num_classes=4
+    )
+    state = init_state(jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (5, 2, 8), 0, cfg.vocab_size)
+    labels = jax.random.randint(jax.random.key(2), (5, 2), 0, 4)
+    _, loss = train_step(state, ids, labels)
+
+    pooled = sb.reference_apply(state.params, ids)
+    logits = (
+        pooled.astype(jnp.float32) @ state.params["cls_w"]
+        + state.params["cls_b"]
+    )
+    want = optax.softmax_cross_entropy_with_integer_labels(
+        logits, labels
+    ).mean()
+    np.testing.assert_allclose(float(loss), float(want), rtol=1e-5)
