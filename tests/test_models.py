@@ -44,6 +44,71 @@ def test_resnet50_has_16_adds():
     assert model.cut_candidates == tuple(f"add_{i}" for i in range(1, 17))
 
 
+@pytest.mark.parametrize(
+    "name,res,feat",
+    [
+        ("mobilenetv2", 96, 1280),
+        ("efficientnet_b0", 96, 1280),
+        ("inceptionv3", 96, 2048),
+        ("inception_resnet_v2", 96, 1536),
+        ("nasnet_mobile", 96, 1056),
+    ],
+)
+def test_new_zoo_builds_with_expected_head(name, res, feat):
+    """Shape-infer each zoo model (GAP heads are resolution-flexible, so
+    a small input keeps eval_shape cheap) and check the penultimate
+    feature width matches the published architecture."""
+    model = get_model(name)
+    params = model.graph.init(jax.random.key(0), (1, res, res, 3))
+    spec = model.graph.output_spec(params, (1, res, res, 3))
+    assert spec.shape == (1, 1000)
+    head = params["predictions_dense"]["kernel"]
+    assert head.shape == (feat, 1000)
+
+
+@pytest.mark.parametrize(
+    "name", ["mobilenetv2", "efficientnet_b0", "inceptionv3",
+             "inception_resnet_v2"]
+)
+def test_new_zoo_cuts_are_valid(name):
+    model = get_model(name)
+    for n in (2, 4, 8):
+        cuts = model.default_cuts(n)
+        assert len(cuts) == n - 1
+        validate_cut_points(model.graph, cuts)
+
+
+def test_nasnet_has_only_honest_cuts():
+    """NASNet's p-skip makes cell boundaries non-articulation points;
+    the model must advertise only genuinely valid cuts."""
+    model = get_model("nasnet_mobile")
+    validate_cut_points(model.graph, model.default_cuts(
+        len(model.cut_candidates) + 1))
+    # A cell output mid-chain is NOT valid (its p companion crosses).
+    from defer_tpu.graph.partition import PartitionError
+    with pytest.raises(PartitionError):
+        validate_cut_points(model.graph, ["cell_2"])
+
+
+def test_mobilenetv2_partition_composes():
+    """Composed pipeline stages must equal the unpartitioned forward
+    (the invariant the reference never checks, SURVEY.md §3.4)."""
+    import jax.numpy as jnp
+
+    from defer_tpu.graph.partition import partition, stage_params
+
+    model = get_model("mobilenetv2")
+    shape = (1, 96, 96, 3)
+    params = model.graph.init(jax.random.key(1), shape)
+    x = jax.random.normal(jax.random.key(2), shape)
+    full = model.graph.apply(params, x)
+    stages = partition(model.graph, model.default_cuts(3))
+    y = x
+    for st in stages:
+        y = st.apply(stage_params(params, st), y)
+    assert jnp.allclose(full, y, atol=1e-5)
+
+
 def test_vgg19_output_shape():
     model = get_model("vgg19")
     # VGG's flatten->dense head fixes the input resolution at 224.

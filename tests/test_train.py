@@ -89,13 +89,14 @@ def test_train_loss_matches_reference_forward(devices):
     state = init_state(jax.random.key(0))
     ids = jax.random.randint(jax.random.key(1), (5, 2, 8), 0, cfg.vocab_size)
     labels = jax.random.randint(jax.random.key(2), (5, 2), 0, 4)
-    _, loss = train_step(state, ids, labels)
-
+    # train_step donates its input state, so take the reference forward
+    # (which needs the pre-update params the loss was computed at) first.
     pooled = sb.reference_apply(state.params, ids)
     logits = (
         pooled.astype(jnp.float32) @ state.params["cls_w"]
         + state.params["cls_b"]
     )
+    _, loss = train_step(state, ids, labels)
     want = optax.softmax_cross_entropy_with_integer_labels(
         logits, labels
     ).mean()
