@@ -1,0 +1,336 @@
+"""Weight transplant: external checkpoints -> GraphParams.
+
+The reference ships weights as raw compressed arrays over sockets
+(reference src/dispatcher.py:75-88, src/node.py:74-92) and relies on
+Keras `set_weights` ordering (reference src/node.py:42). Here the
+analogous machinery is a layout-aware importer: it walks the IR graph,
+asks a `WeightSource` for each parameter, converts the source
+framework's array layout to ours (NHWC activations / HWIO kernels — the
+TPU-native layout), shape-checks, and returns a fresh GraphParams
+pytree.
+
+Two sources are built in:
+
+  * `KerasWeights` — Keras-style `{layer_name: [arrays]}` in Keras's
+    `get_weights()` ordering (conv kernels already HWIO, depthwise
+    kernels (kh, kw, cin, mult)). `load_keras_h5` reads the dict out of
+    a Keras `save_weights` HDF5 file.
+  * `TorchStateDict` — a torch `state_dict` (conv kernels OIHW,
+    linear (out, in), BN running stats), with a configurable node-name
+    -> torch-prefix map.
+
+`export_keras_weights` is the inverse (GraphParams -> Keras-layout
+dict), giving a lossless round trip and an interop path back to the
+reference's ecosystem.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Mapping, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from defer_tpu.graph.ir import Graph, GraphParams, OpNode
+from defer_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class TransplantError(ValueError):
+    pass
+
+
+# --------------------------------------------------------------------------
+# Layout conversion, per op kind
+# --------------------------------------------------------------------------
+
+# Keras get_weights() ordering per op kind; None entries are skipped
+# (parameters our init chose not to create, e.g. a disabled bias).
+_KERAS_ORDER: dict[str, tuple[str, ...]] = {
+    "conv": ("kernel", "bias"),
+    "depthwise_conv": ("kernel", "bias"),
+    "dense": ("kernel", "bias"),
+    "batch_norm": ("scale", "bias", "mean", "var"),
+}
+
+_TORCH_KEYS: dict[str, dict[str, str]] = {
+    "conv": {"kernel": "weight", "bias": "bias"},
+    "depthwise_conv": {"kernel": "weight", "bias": "bias"},
+    "dense": {"kernel": "weight", "bias": "bias"},
+    "batch_norm": {
+        "scale": "weight",
+        "bias": "bias",
+        "mean": "running_mean",
+        "var": "running_var",
+    },
+    "layer_norm": {"scale": "weight", "bias": "bias"},
+    "embedding": {"table": "weight"},
+    "pos_embedding": {"table": "weight"},
+}
+
+
+def _from_keras(op: str, param: str, value: np.ndarray) -> np.ndarray:
+    if op == "depthwise_conv" and param == "kernel":
+        # (kh, kw, cin, mult) -> (kh, kw, 1, cin*mult). C-order flatten
+        # puts output channel c*mult + m exactly where XLA's
+        # feature_group_count=cin grouping expects it.
+        kh, kw = value.shape[:2]
+        return value.reshape(kh, kw, 1, -1)
+    return value
+
+
+def _to_keras(op: str, param: str, value: np.ndarray, attrs) -> np.ndarray:
+    if op == "depthwise_conv" and param == "kernel":
+        kh, kw, _, cm = value.shape
+        mult = int(attrs.get("depth_multiplier", 1))
+        return value.reshape(kh, kw, cm // mult, mult)
+    return value
+
+
+def _from_torch(op: str, param: str, value: np.ndarray) -> np.ndarray:
+    if param == "kernel":
+        if op == "conv":
+            return np.transpose(value, (2, 3, 1, 0))  # OIHW -> HWIO
+        if op == "depthwise_conv":
+            # (cin*mult, 1, kh, kw) -> (kh, kw, 1, cin*mult); torch
+            # groups=cin ordering matches XLA's (both c*mult + m).
+            return np.transpose(value, (2, 3, 1, 0))
+        if op == "dense":
+            return np.transpose(value, (1, 0))  # (out, in) -> (in, out)
+    return value
+
+
+# --------------------------------------------------------------------------
+# Weight sources
+# --------------------------------------------------------------------------
+
+
+class WeightSource:
+    """Protocol: yield converted arrays for a node, or None to skip."""
+
+    def get(self, node: OpNode, param: str, shape: tuple[int, ...]):
+        raise NotImplementedError
+
+    def keys_used(self) -> set[str]:
+        raise NotImplementedError
+
+    def all_keys(self) -> set[str]:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class KerasWeights(WeightSource):
+    """Keras-style `{layer_name: [arrays in get_weights() order]}`.
+
+    `name_map` translates IR node names to source layer names (identity
+    by default — the zoo's node naming is already Keras-shaped).
+
+    `bn_missing` names the BN param a three-array BatchNormalization
+    list is missing: Keras drops gamma from the FRONT for scale=False
+    (the Inception family's config) and beta from the middle for
+    center=False, so the array count alone cannot disambiguate.
+    """
+
+    weights: Mapping[str, Sequence[np.ndarray]]
+    name_map: Callable[[str], str] = staticmethod(lambda n: n)
+    bn_missing: str = "scale"
+
+    def __post_init__(self) -> None:
+        self._used: set[str] = set()
+        if self.bn_missing not in ("scale", "bias"):
+            raise TransplantError(
+                f"bn_missing must be 'scale' or 'bias', got {self.bn_missing!r}"
+            )
+
+    def _present(self, op: str, n_arrays: int) -> tuple[str, ...]:
+        order = _KERAS_ORDER[op]
+        if op == "batch_norm" and n_arrays < 4:
+            # Keras get_weights order is [gamma?][beta?] mean var, with
+            # gamma/beta independently omitted by scale=False /
+            # center=False — not truncated from the end.
+            if n_arrays == 2:
+                return ("mean", "var")
+            if n_arrays == 3:
+                keep = tuple(p for p in order if p != self.bn_missing)
+                return keep
+        # Other ops only ever omit the trailing bias (use_bias=False).
+        return order[:n_arrays]
+
+    def get(self, node: OpNode, param: str, shape):
+        key = self.name_map(node.name)
+        if key not in self.weights:
+            return None
+        order = _KERAS_ORDER.get(node.op)
+        if order is None or param not in order:
+            raise TransplantError(
+                f"no Keras layout rule for op {node.op!r} param {param!r} "
+                f"(node {node.name!r})"
+            )
+        arrays = list(self.weights[key])
+        present = self._present(node.op, len(arrays))
+        if param not in present:
+            return None
+        self._used.add(key)
+        return _from_keras(node.op, param, np.asarray(arrays[present.index(param)]))
+
+    def keys_used(self) -> set[str]:
+        return self._used
+
+    def all_keys(self) -> set[str]:
+        return set(self.weights)
+
+
+@dataclasses.dataclass
+class TorchStateDict(WeightSource):
+    """A torch ``state_dict`` source.
+
+    `name_map` translates an IR node name to the torch module prefix
+    (e.g. ``"conv1_conv" -> "conv1"``); the per-parameter suffix
+    (``weight`` / ``bias`` / ``running_mean`` / ...) is appended by op
+    kind. Identity prefix map by default.
+    """
+
+    state_dict: Mapping[str, Any]
+    name_map: Callable[[str], str] = staticmethod(lambda n: n)
+
+    def __post_init__(self) -> None:
+        self._used: set[str] = set()
+
+    def get(self, node: OpNode, param: str, shape):
+        keys = _TORCH_KEYS.get(node.op)
+        if keys is None or param not in keys:
+            # Unknown op kinds are simply not covered by this source;
+            # strict transplant() reports the node as missing, and
+            # strict=False keeps its initialized values.
+            return None
+        key = f"{self.name_map(node.name)}.{keys[param]}"
+        if key not in self.state_dict:
+            return None
+        value = self.state_dict[key]
+        if hasattr(value, "detach"):  # torch.Tensor without importing torch
+            value = value.detach().cpu().numpy()
+        self._used.add(key)
+        return _from_torch(node.op, param, np.asarray(value))
+
+    def keys_used(self) -> set[str]:
+        return self._used
+
+    def all_keys(self) -> set[str]:
+        return set(self.state_dict)
+
+
+# --------------------------------------------------------------------------
+# Transplant / export
+# --------------------------------------------------------------------------
+
+
+def transplant(
+    graph: Graph,
+    params: GraphParams,
+    source: WeightSource,
+    *,
+    strict: bool = True,
+    dtype: Any | None = None,
+) -> dict:
+    """Return a copy of `params` with every array the source provides.
+
+    strict=True (default) raises if any parameterized node gets nothing
+    from the source — the failure mode the reference hits silently when
+    `set_weights` ordering drifts (reference src/node.py:42).
+    """
+    out: dict = {}
+    missing: list[str] = []
+    for node in graph.nodes:
+        node_params = params.get(node.name, {})
+        if not node_params:
+            out[node.name] = node_params
+            continue
+        loaded = {}
+        got_any = False
+        for pname, cur in node_params.items():
+            value = source.get(node, pname, tuple(cur.shape))
+            if value is None:
+                loaded[pname] = cur
+                continue
+            if tuple(value.shape) != tuple(cur.shape):
+                raise TransplantError(
+                    f"shape mismatch for {node.name}.{pname}: checkpoint "
+                    f"{tuple(value.shape)} vs model {tuple(cur.shape)}"
+                )
+            loaded[pname] = jnp.asarray(value, dtype or cur.dtype)
+            got_any = True
+        if not got_any:
+            missing.append(node.name)
+        out[node.name] = loaded
+    if strict and missing:
+        raise TransplantError(
+            f"source provided no weights for {len(missing)} parameterized "
+            f"nodes, e.g. {missing[:5]}; pass strict=False to keep their "
+            "initialized values"
+        )
+    unused = source.all_keys() - source.keys_used()
+    if unused:
+        # Typo'd layer names silently strand checkpoint arrays — the
+        # reference's set_weights path has no such diagnostic at all
+        # (reference src/node.py:42).
+        log.warning(
+            "transplant: %d checkpoint keys unused, e.g. %s",
+            len(unused),
+            sorted(unused)[:5],
+        )
+    return out
+
+
+def export_keras_weights(
+    graph: Graph, params: GraphParams
+) -> dict[str, list[np.ndarray]]:
+    """GraphParams -> Keras-layout `{layer: [arrays]}` (round-trippable
+    through KerasWeights, and loadable into a same-architecture Keras
+    model via `set_weights` for interop with the reference)."""
+    out: dict[str, list[np.ndarray]] = {}
+    node_map = graph.node_map
+    for name, node_params in params.items():
+        if not node_params:
+            continue
+        node = node_map[name]
+        order = _KERAS_ORDER.get(node.op)
+        if order is None:
+            raise TransplantError(
+                f"no Keras layout rule for op {node.op!r} (node {name!r})"
+            )
+        out[name] = [
+            _to_keras(node.op, p, np.asarray(node_params[p]), node.attrs)
+            for p in order
+            if p in node_params
+        ]
+    return out
+
+
+def load_keras_h5(path: str) -> dict[str, list[np.ndarray]]:
+    """Read a Keras `save_weights` HDF5 file into `{layer: [arrays]}`.
+
+    Supports the classic topological layout (`layer_names` /
+    `weight_names` attrs), which is what `tf.keras` writes for the
+    reference's zoo models.
+    """
+    import h5py
+
+    out: dict[str, list[np.ndarray]] = {}
+    with h5py.File(path, "r") as f:
+        root = f["model_weights"] if "model_weights" in f else f
+        layer_names = [
+            n.decode() if isinstance(n, bytes) else n
+            for n in root.attrs.get("layer_names", list(root.keys()))
+        ]
+        for lname in layer_names:
+            g = root[lname]
+            weight_names = [
+                n.decode() if isinstance(n, bytes) else n
+                for n in g.attrs.get("weight_names", [])
+            ]
+            arrays = [np.asarray(g[w]) for w in weight_names]
+            if arrays:
+                out[lname] = arrays
+    return out
