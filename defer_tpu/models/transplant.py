@@ -218,7 +218,12 @@ class TorchStateDict(WeightSource):
         return self._used
 
     def all_keys(self) -> set[str]:
-        return set(self.state_dict)
+        # num_batches_tracked is BN bookkeeping with no analogue here;
+        # exclude it so the unused-keys diagnostic stays signal.
+        return {
+            k for k in self.state_dict
+            if not k.endswith(".num_batches_tracked")
+        }
 
 
 # --------------------------------------------------------------------------

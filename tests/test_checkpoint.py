@@ -1,0 +1,70 @@
+"""Checkpoint save/resume over the native codec."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from defer_tpu.models import get_model
+from defer_tpu.runtime.checkpoint import load_checkpoint, save_checkpoint
+
+
+def test_graphparams_round_trip(tmp_path):
+    model = get_model("vgg16")
+    params = model.graph.init(jax.random.key(0), (1, 224, 224, 3))
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, params)
+    back = load_checkpoint(path)
+    flat_a = jax.tree_util.tree_leaves_with_path(dict(params))
+    flat_b = jax.tree_util.tree_leaves_with_path(back)
+    assert len(flat_a) == len(flat_b)
+    for (ka, va), (kb, vb) in zip(flat_a, flat_b):
+        assert ka == kb
+        np.testing.assert_array_equal(np.asarray(va), np.asarray(vb))
+
+
+def test_bfloat16_round_trip(tmp_path):
+    params = {
+        "layer": {
+            "w": jnp.asarray(
+                np.random.default_rng(0).standard_normal((16, 8)), jnp.bfloat16
+            ),
+            "b": jnp.zeros((8,), jnp.float32),
+        }
+    }
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, params)
+    back = load_checkpoint(path)
+    assert back["layer"]["w"].dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(back["layer"]["w"]).view(np.uint16),
+        np.asarray(params["layer"]["w"]).view(np.uint16),
+    )
+
+
+def test_resume_gives_identical_forward(tmp_path):
+    """The checkpoint/resume contract: a forward pass from restored
+    params is bit-identical."""
+    model = get_model("mobilenetv2")
+    shape = (1, 96, 96, 3)
+    params = model.graph.init(jax.random.key(1), shape)
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, params)
+    restored = load_checkpoint(path)
+    x = jax.random.normal(jax.random.key(2), shape)
+    np.testing.assert_array_equal(
+        np.asarray(model.graph.apply(params, x)),
+        np.asarray(model.graph.apply(restored, x)),
+    )
+
+
+def test_bad_file_raises(tmp_path):
+    p = tmp_path / "junk"
+    p.write_bytes(b"not a checkpoint")
+    with pytest.raises(ValueError, match="not a defer_tpu checkpoint"):
+        load_checkpoint(str(p))
+
+
+def test_key_with_separator_rejected(tmp_path):
+    with pytest.raises(ValueError, match="may not contain"):
+        save_checkpoint(str(tmp_path / "c"), {"a/b": jnp.zeros(3)})

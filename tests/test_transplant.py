@@ -76,11 +76,14 @@ def test_torch_transplant_matches_torch_forward():
             x = x.mean(dim=(2, 3))
             return self.fc(x)
 
-    net = Net().eval()
-    # Make BN stats non-trivial.
+    # Prime in train mode so running_mean/var move off their 0/1
+    # defaults (which would mask a failure to transplant them), then
+    # freeze for the comparison.
+    net = Net().train()
     with torch.no_grad():
         net(torch.randn(16, 3, 16, 16))
     net.eval()
+    assert float(net.bn1.running_mean.abs().sum()) > 0
 
     b = GraphBuilder("tiny")
     x = b.input("input")
@@ -159,7 +162,7 @@ def test_torch_partial_transplant_skips_unknown_ops():
     params = graph.init(
         jax.random.key(0), (1, 3), input_dtype=jnp_.int32
     )
-    import torch
+    torch = pytest.importorskip("torch")
 
     sd = {"ln.weight": torch.ones(4) * 5, "ln.bias": torch.zeros(4)}
     out = transplant(graph, params, TorchStateDict(sd), strict=False)
