@@ -1,0 +1,406 @@
+"""Keras `model.to_json()` ingester -> IR Graph.
+
+The reference's wire format for model architectures IS Keras JSON: the
+dispatcher ships `model.to_json()` strings (reference
+src/dispatcher.py:52) and nodes rebuild with `model_from_json`
+(reference src/node.py:38). This module is the compatibility path for
+that ecosystem: a user bringing a serialized Keras model (plus an h5
+weights file via `transplant.load_keras_h5`) gets an IR Graph that
+partitions/pipelines like any zoo model.
+
+Supports the classic functional-model JSON layout (`config.layers`
+with `inbound_nodes` as `[[[layer, node_idx, tensor_idx, kwargs]...]]`)
+that TF1-era Keras — the reference's environment (reference
+src/node.py:19-20) — emits, restricted to single-input single-output
+graphs (the same restriction the reference's partitioner has, reference
+src/dag_util.py:29-33).
+
+Layers with fused activations (e.g. Conv2D(activation='relu')) expand
+to two IR nodes; the activation node is named `<layer>_activation_fused`
+and downstream edges re-point to it, while the parameterized node keeps
+the layer name so `KerasWeights`' identity name_map finds its arrays.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Mapping
+
+from defer_tpu.graph.ir import Graph, GraphBuilder
+
+
+class KerasImportError(ValueError):
+    pass
+
+
+def _pad_attr(cfg: Mapping[str, Any]) -> str:
+    return str(cfg.get("padding", "valid")).upper()
+
+
+_ACTIVATIONS = {
+    "relu": "relu",
+    "relu6": "relu6",
+    "sigmoid": "sigmoid",
+    "tanh": "tanh",
+    "swish": "swish",
+    "silu": "swish",
+    "gelu": "gelu",
+    "softmax": "softmax",
+    "linear": None,
+}
+
+
+def _activation_op(name: str) -> str | None:
+    try:
+        return _ACTIVATIONS[name]
+    except KeyError:
+        raise KerasImportError(
+            f"unsupported Keras activation {name!r}; supported: "
+            f"{sorted(k for k in _ACTIVATIONS)}"
+        ) from None
+
+
+# Each handler: (builder, name, config, inputs) -> output node name.
+_HANDLERS: dict[str, Callable] = {}
+
+
+def _handler(*class_names: str) -> Callable:
+    def deco(fn: Callable) -> Callable:
+        for cn in class_names:
+            _HANDLERS[cn] = fn
+        return fn
+
+    return deco
+
+
+def _fused_activation(b: GraphBuilder, x: str, name: str, cfg) -> str:
+    act = cfg.get("activation")
+    if act in (None, "linear"):
+        return x
+    op = _activation_op(act)
+    return b.add(op, x, name=f"{name}_activation_fused")
+
+
+@_handler("Conv2D")
+def _conv(b: GraphBuilder, name: str, cfg, inputs):
+    x = b.add(
+        "conv",
+        inputs[0],
+        name=name,
+        features=int(cfg["filters"]),
+        kernel_size=tuple(cfg["kernel_size"]),
+        strides=tuple(cfg.get("strides", (1, 1))),
+        padding=_pad_attr(cfg),
+        dilation=tuple(cfg.get("dilation_rate", (1, 1))),
+        groups=int(cfg.get("groups", 1)),
+        use_bias=bool(cfg.get("use_bias", True)),
+    )
+    return _fused_activation(b, x, name, cfg)
+
+
+@_handler("DepthwiseConv2D")
+def _depthwise(b: GraphBuilder, name: str, cfg, inputs):
+    x = b.add(
+        "depthwise_conv",
+        inputs[0],
+        name=name,
+        kernel_size=tuple(cfg["kernel_size"]),
+        strides=tuple(cfg.get("strides", (1, 1))),
+        padding=_pad_attr(cfg),
+        dilation=tuple(cfg.get("dilation_rate", (1, 1))),
+        depth_multiplier=int(cfg.get("depth_multiplier", 1)),
+        use_bias=bool(cfg.get("use_bias", True)),
+    )
+    return _fused_activation(b, x, name, cfg)
+
+
+@_handler("Dense")
+def _dense(b: GraphBuilder, name: str, cfg, inputs):
+    x = b.add(
+        "dense",
+        inputs[0],
+        name=name,
+        features=int(cfg["units"]),
+        use_bias=bool(cfg.get("use_bias", True)),
+    )
+    return _fused_activation(b, x, name, cfg)
+
+
+@_handler("BatchNormalization")
+def _bn(b: GraphBuilder, name: str, cfg, inputs):
+    axis = cfg.get("axis", -1)
+    if isinstance(axis, (list, tuple)):
+        axis = axis[0]
+    if axis not in (-1, 3):
+        raise KerasImportError(
+            f"BatchNormalization {name!r}: only channels-last (axis=-1/3) "
+            f"is supported, got axis={axis}"
+        )
+    return b.add(
+        "batch_norm", inputs[0], name=name, eps=float(cfg.get("epsilon", 1e-3))
+    )
+
+
+@_handler("Activation")
+def _activation(b: GraphBuilder, name: str, cfg, inputs):
+    op = _activation_op(cfg["activation"])
+    if op is None:
+        return b.add("identity", inputs[0], name=name)
+    return b.add(op, inputs[0], name=name)
+
+
+@_handler("ReLU")
+def _relu_layer(b: GraphBuilder, name: str, cfg, inputs):
+    slope = float(cfg.get("negative_slope") or 0.0)
+    threshold = float(cfg.get("threshold") or 0.0)
+    if slope != 0.0 or threshold != 0.0:
+        raise KerasImportError(
+            f"ReLU {name!r}: negative_slope/threshold variants are not "
+            f"supported (got slope={slope}, threshold={threshold})"
+        )
+    mv = cfg.get("max_value")
+    if mv is not None and float(mv) == 6.0:
+        return b.add("relu6", inputs[0], name=name)
+    if mv is not None:
+        raise KerasImportError(f"ReLU {name!r}: unsupported max_value {mv}")
+    return b.add("relu", inputs[0], name=name)
+
+
+@_handler("Softmax")
+def _softmax_layer(b: GraphBuilder, name: str, cfg, inputs):
+    return b.add("softmax", inputs[0], name=name, axis=int(cfg.get("axis", -1)))
+
+
+@_handler("MaxPooling2D")
+def _max_pool(b: GraphBuilder, name: str, cfg, inputs):
+    return b.add(
+        "max_pool",
+        inputs[0],
+        name=name,
+        window=tuple(cfg.get("pool_size", (2, 2))),
+        strides=tuple(cfg.get("strides") or cfg.get("pool_size", (2, 2))),
+        padding=_pad_attr(cfg),
+    )
+
+
+@_handler("AveragePooling2D")
+def _avg_pool(b: GraphBuilder, name: str, cfg, inputs):
+    return b.add(
+        "avg_pool",
+        inputs[0],
+        name=name,
+        window=tuple(cfg.get("pool_size", (2, 2))),
+        strides=tuple(cfg.get("strides") or cfg.get("pool_size", (2, 2))),
+        padding=_pad_attr(cfg),
+    )
+
+
+@_handler("GlobalAveragePooling2D")
+def _gap(b: GraphBuilder, name: str, cfg, inputs):
+    return b.add(
+        "global_avg_pool", inputs[0], name=name,
+        keepdims=bool(cfg.get("keepdims", False)),
+    )
+
+
+@_handler("GlobalMaxPooling2D")
+def _gmp(b: GraphBuilder, name: str, cfg, inputs):
+    return b.add(
+        "global_max_pool", inputs[0], name=name,
+        keepdims=bool(cfg.get("keepdims", False)),
+    )
+
+
+@_handler("ZeroPadding2D")
+def _zero_pad(b: GraphBuilder, name: str, cfg, inputs):
+    pad = cfg["padding"]
+    if isinstance(pad, int):
+        pad = ((pad, pad), (pad, pad))
+    else:
+        pad = tuple(
+            (p, p) if isinstance(p, int) else tuple(p) for p in pad
+        )
+    return b.add("zero_pad", inputs[0], name=name, padding=pad)
+
+
+@_handler("Cropping2D")
+def _crop(b: GraphBuilder, name: str, cfg, inputs):
+    crop = cfg["cropping"]
+    if isinstance(crop, int):
+        crop = ((crop, crop), (crop, crop))
+    else:
+        crop = tuple(
+            (c, c) if isinstance(c, int) else tuple(c) for c in crop
+        )
+    return b.add("crop", inputs[0], name=name, cropping=crop)
+
+
+@_handler("Flatten")
+def _flatten(b: GraphBuilder, name: str, cfg, inputs):
+    return b.add("flatten", inputs[0], name=name)
+
+
+@_handler("Reshape")
+def _reshape(b: GraphBuilder, name: str, cfg, inputs):
+    return b.add(
+        "reshape", inputs[0], name=name, shape=tuple(cfg["target_shape"])
+    )
+
+
+@_handler("Dropout", "SpatialDropout2D", "GaussianDropout")
+def _dropout(b: GraphBuilder, name: str, cfg, inputs):
+    return b.add("dropout", inputs[0], name=name)
+
+
+@_handler("Add")
+def _add(b: GraphBuilder, name: str, cfg, inputs):
+    return b.add("add", *inputs, name=name)
+
+
+@_handler("Multiply")
+def _multiply(b: GraphBuilder, name: str, cfg, inputs):
+    return b.add("multiply", *inputs, name=name)
+
+
+@_handler("Concatenate")
+def _concat(b: GraphBuilder, name: str, cfg, inputs):
+    return b.add("concat", *inputs, name=name, axis=int(cfg.get("axis", -1)))
+
+
+def supported_layers() -> list[str]:
+    return sorted(_HANDLERS)
+
+
+def _inbound_names(inbound_nodes: Any) -> list[str]:
+    """Extract producer layer names from classic inbound_nodes JSON:
+    [[[layer_name, node_index, tensor_index, kwargs], ...]] (one outer
+    entry — shared layers called multiple times are out of scope, as in
+    the reference)."""
+    if not inbound_nodes:
+        return []
+    if len(inbound_nodes) != 1:
+        raise KerasImportError(
+            "shared layers (multiple inbound nodes) are not supported"
+        )
+    names = []
+    for entry in inbound_nodes[0]:
+        name, node_idx, tensor_idx = entry[0], entry[1], entry[2]
+        if node_idx != 0 or tensor_idx != 0:
+            raise KerasImportError(
+                f"non-trivial inbound node ({name}, {node_idx}, "
+                f"{tensor_idx}) is not supported"
+            )
+        names.append(name)
+    return names
+
+
+def from_keras_json(text: str | Mapping[str, Any]) -> tuple[Graph, tuple[int, ...]]:
+    """Parse a Keras functional-model JSON into (Graph, input_shape).
+
+    input_shape excludes the batch dimension. Raises KerasImportError
+    for unsupported layer classes/configs with an explicit message —
+    the reference would fail deep inside deserialization instead.
+    """
+    spec = json.loads(text) if isinstance(text, str) else text
+    if spec.get("class_name") not in ("Functional", "Model"):
+        raise KerasImportError(
+            f"expected a functional model JSON, got class "
+            f"{spec.get('class_name')!r}"
+        )
+    cfg = spec["config"]
+    layers = cfg["layers"]
+
+    in_specs = cfg.get("input_layers")
+    out_specs = cfg.get("output_layers")
+    if in_specs is None or out_specs is None:
+        raise KerasImportError("model JSON lacks input_layers/output_layers")
+    if len(in_specs) != 1 or len(out_specs) != 1:
+        raise KerasImportError(
+            "only single-input single-output models are supported (the "
+            "reference has the same restriction)"
+        )
+    input_layer, output_layer = in_specs[0][0], out_specs[0][0]
+
+    b = GraphBuilder(cfg.get("name", "keras_model"))
+    produced: dict[str, str] = {}  # layer name -> IR node producing its output
+    input_shape: tuple[int, ...] | None = None
+
+    for layer in layers:
+        cls = layer["class_name"]
+        lcfg = layer["config"]
+        name = layer.get("name", lcfg.get("name"))
+        if cls == "InputLayer":
+            if name != input_layer:
+                raise KerasImportError(
+                    f"unexpected extra InputLayer {name!r}"
+                )
+            shape = lcfg.get("batch_input_shape") or lcfg.get("batch_shape")
+            if shape:
+                input_shape = tuple(int(d) for d in shape[1:])
+            produced[name] = b.input(name)
+            continue
+        handler = _HANDLERS.get(cls)
+        if handler is None:
+            raise KerasImportError(
+                f"unsupported Keras layer class {cls!r} (layer {name!r}); "
+                f"supported: {supported_layers()}"
+            )
+        srcs = _inbound_names(layer.get("inbound_nodes"))
+        if not srcs:
+            raise KerasImportError(f"layer {name!r} has no inbound nodes")
+        try:
+            inputs = [produced[s] for s in srcs]
+        except KeyError as e:
+            raise KerasImportError(
+                f"layer {name!r} consumes undeclared layer {e.args[0]!r}"
+            ) from None
+        produced[name] = handler(b, name, lcfg, inputs)
+
+    if output_layer not in produced:
+        raise KerasImportError(f"output layer {output_layer!r} not found")
+    graph = b.build(produced[output_layer])
+    if input_shape is None:
+        raise KerasImportError("InputLayer lacks batch_input_shape")
+    return graph, input_shape
+
+
+def model_from_keras(
+    text: str | Mapping[str, Any],
+    *,
+    weights_h5: str | None = None,
+    params=None,
+    rng=None,
+):
+    """Keras JSON (+ optional h5 weights) -> (Model, params | None).
+
+    The full compatibility path: the artifacts a reference user already
+    has (`model.to_json()` string, `save_weights` h5) become a zoo-style
+    Model with auto-discovered cut candidates, ready for
+    `DEFER().run_defer`. Returns (model, params); params is None unless
+    weights_h5 is given (init with `model.init(rng)` as usual).
+    """
+    import jax
+
+    from defer_tpu.graph.partition import articulation_points
+    from defer_tpu.models import Model
+
+    graph, input_shape = from_keras_json(text)
+    model = Model(
+        name=graph.name,
+        graph=graph,
+        input_shape=input_shape,
+        cut_candidates=tuple(articulation_points(graph)),
+    )
+    loaded = params
+    if weights_h5 is not None:
+        from defer_tpu.models.transplant import (
+            KerasWeights,
+            load_keras_h5,
+            transplant,
+        )
+
+        base = model.init(rng if rng is not None else jax.random.key(0))
+        loaded = transplant(
+            graph, base, KerasWeights(load_keras_h5(weights_h5))
+        )
+    return model, loaded

@@ -73,6 +73,27 @@ def validate_cut_points(graph: Graph, cuts: Sequence[str]) -> None:
         prev_ancestors = anc
 
 
+def articulation_points(graph: Graph) -> list[str]:
+    """All valid single-tensor cut points, in topological order.
+
+    The discovery the reference leaves to the user: its README-era cut
+    lists were found by hand (reference src/test.py:24-28 documents
+    them in a comment). A node c qualifies iff every edge leaving c's
+    ancestor set originates at c itself.
+    """
+    edges = [(inp, n.name) for n in graph.nodes for inp in n.inputs]
+    points: list[str] = []
+    for node in graph.nodes:
+        if node.name in (graph.input_name, graph.output_name):
+            continue
+        anc = graph.ancestors(node.name)
+        if all(
+            u == node.name or u not in anc or v in anc for u, v in edges
+        ):
+            points.append(node.name)
+    return points
+
+
 def partition(graph: Graph, cuts: Sequence[str]) -> list[Graph]:
     """Split `graph` at `cuts` into a chain of stage graphs.
 

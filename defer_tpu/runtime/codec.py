@@ -123,13 +123,16 @@ def encode(arr: np.ndarray, *, level: int = 3) -> bytes:
         dst = ctypes.create_string_buffer(cap)
         n = lib.defer_codec_encode(raw, len(raw), elem, level, dst, cap)
         if n:
-            payload = dst.raw[:n]
+            # string_at copies only the n compressed bytes (dst.raw[:n]
+            # would materialize the whole bound-sized buffer first).
+            payload = ctypes.string_at(dst, n)
             scheme = SCHEME_ZSTD_SHUFFLE
         else:
             log.warning("native codec encode failed; using fallback")
     if payload is None:
         shuffled = _shuffle_np(raw, elem) if elem > 1 and raw else raw
-        payload = zlib.compress(shuffled, level)
+        # zstd levels run to 22; clamp for zlib's 0-9 range.
+        payload = zlib.compress(shuffled, min(level, 9))
 
     header = struct.pack(
         f"<2sBBB{len(dtype)}sB{arr.ndim}q",

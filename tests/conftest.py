@@ -35,3 +35,18 @@ def devices():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
     return devs
+
+
+def write_keras_h5(path: str, weights: dict) -> None:
+    """Write `{layer: [arrays]}` in the classic Keras save_weights h5
+    layout (layer_names/weight_names attrs) for transplant tests."""
+    import h5py
+
+    with h5py.File(path, "w") as f:
+        f.attrs["layer_names"] = [n.encode() for n in weights]
+        for lname, arrays in weights.items():
+            g = f.create_group(lname)
+            wnames = [f"{lname}/w{i}".encode() for i in range(len(arrays))]
+            g.attrs["weight_names"] = wnames
+            for wn, a in zip(wnames, arrays):
+                g.create_dataset(wn.decode(), data=a)

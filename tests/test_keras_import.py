@@ -1,0 +1,162 @@
+"""Keras to_json ingester: fixture parsing, forward equivalence with a
+hand-built IR graph, auto cut discovery, and error paths."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from defer_tpu.graph.ir import GraphBuilder
+from defer_tpu.graph.keras_import import (
+    KerasImportError,
+    from_keras_json,
+    model_from_keras,
+)
+from defer_tpu.graph.partition import articulation_points, validate_cut_points
+from defer_tpu.models import get_model
+
+
+def _layer(cls, name, inbound, **config):
+    config.setdefault("name", name)
+    return {
+        "class_name": cls,
+        "name": name,
+        "config": config,
+        "inbound_nodes": [[[src, 0, 0, {}] for src in inbound]] if inbound else [],
+    }
+
+
+def _residual_json():
+    """A small residual CNN in classic functional-model JSON."""
+    layers = [
+        _layer("InputLayer", "input_1", [], batch_input_shape=[None, 16, 16, 3]),
+        _layer("ZeroPadding2D", "pad", ["input_1"], padding=[[1, 1], [1, 1]]),
+        _layer(
+            "Conv2D", "conv1", ["pad"], filters=8, kernel_size=[3, 3],
+            strides=[1, 1], padding="valid", use_bias=False,
+            activation="linear",
+        ),
+        _layer("BatchNormalization", "bn1", ["conv1"], axis=3, epsilon=1.1e-5),
+        _layer("Activation", "act1", ["bn1"], activation="relu"),
+        _layer(
+            "Conv2D", "conv2", ["act1"], filters=8, kernel_size=[3, 3],
+            padding="same", use_bias=True, activation="relu",
+        ),
+        _layer("Add", "add_1", ["conv2", "act1"]),
+        _layer("MaxPooling2D", "pool", ["add_1"], pool_size=[2, 2], strides=[2, 2], padding="valid"),
+        _layer("GlobalAveragePooling2D", "gap", ["pool"]),
+        _layer("Dropout", "drop", ["gap"], rate=0.5),
+        _layer("Dense", "fc", ["drop"], units=10, activation="softmax"),
+    ]
+    return json.dumps(
+        {
+            "class_name": "Functional",
+            "config": {
+                "name": "toy_resnet",
+                "layers": layers,
+                "input_layers": [["input_1", 0, 0]],
+                "output_layers": [["fc", 0, 0]],
+            },
+        }
+    )
+
+
+def test_ingest_matches_hand_built_graph():
+    graph, input_shape = from_keras_json(_residual_json())
+    assert input_shape == (16, 16, 3)
+
+    b = GraphBuilder("manual")
+    x = b.input("input_1")
+    x = b.add("zero_pad", x, name="pad", padding=((1, 1), (1, 1)))
+    x = b.add("conv", x, name="conv1", features=8, kernel_size=(3, 3),
+              strides=(1, 1), padding="VALID", use_bias=False)
+    x = b.add("batch_norm", x, name="bn1", eps=1.1e-5)
+    x = b.add("relu", x, name="act1")
+    y = b.add("conv", x, name="conv2", features=8, kernel_size=(3, 3),
+              padding="SAME", use_bias=True)
+    y = b.add("relu", y, name="conv2_activation_fused")
+    x = b.add("add", y, x, name="add_1")
+    x = b.add("max_pool", x, name="pool", window=(2, 2), strides=(2, 2),
+              padding="VALID")
+    x = b.add("global_avg_pool", x, name="gap")
+    x = b.add("dropout", x, name="drop")
+    x = b.add("dense", x, name="fc", features=10)
+    x = b.add("softmax", x, name="fc_activation_fused")
+    manual = b.build(x)
+
+    shape = (2, 16, 16, 3)
+    p1 = graph.init(jax.random.key(0), shape)
+    p2 = manual.init(jax.random.key(0), shape)
+    xin = jax.random.normal(jax.random.key(1), shape)
+    np.testing.assert_allclose(
+        np.asarray(graph.apply(p1, xin)),
+        np.asarray(manual.apply(p2, xin)),
+        rtol=1e-6,
+    )
+
+
+def test_imported_model_partitions_and_runs():
+    model, params = model_from_keras(_residual_json())
+    assert params is None
+    assert "add_1" in model.cut_candidates
+    # Nodes inside the residual branch must NOT be candidates.
+    assert "conv2" not in model.cut_candidates
+    cuts = ["add_1"]
+    validate_cut_points(model.graph, cuts)
+    from defer_tpu.graph.partition import partition, stage_params
+
+    params = model.init(jax.random.key(0))
+    x = jnp.ones((1, 16, 16, 3))
+    full = model.graph.apply(params, x)
+    y = x
+    for st in partition(model.graph, cuts):
+        y = st.apply(stage_params(params, st), y)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(y), rtol=1e-6)
+
+
+def test_articulation_points_superset_of_resnet_adds():
+    model = get_model("resnet50")
+    pts = set(articulation_points(model.graph))
+    assert set(model.cut_candidates) <= pts
+    assert "res2a_b_relu" not in pts  # inside a residual branch
+
+
+def test_unsupported_layer_raises():
+    bad = json.loads(_residual_json())
+    bad["config"]["layers"][2]["class_name"] = "LocallyConnected2D"
+    with pytest.raises(KerasImportError, match="LocallyConnected2D"):
+        from_keras_json(bad)
+
+
+def test_multi_output_rejected():
+    spec = json.loads(_residual_json())
+    spec["config"]["output_layers"].append(["gap", 0, 0])
+    with pytest.raises(KerasImportError, match="single-input single-output"):
+        from_keras_json(spec)
+
+
+def test_sequential_rejected_with_clear_error():
+    with pytest.raises(KerasImportError, match="functional"):
+        from_keras_json(json.dumps({"class_name": "Sequential", "config": {}}))
+
+
+def test_h5_weights_path(tmp_path):
+    """JSON + h5 weights -> running model with transplanted params."""
+    from conftest import write_keras_h5
+
+    from defer_tpu.models.transplant import export_keras_weights
+
+    model, _ = model_from_keras(_residual_json())
+    params = model.init(jax.random.key(3))
+    kw = export_keras_weights(model.graph, params)
+    path = str(tmp_path / "w.h5")
+    write_keras_h5(path, kw)
+
+    model2, loaded = model_from_keras(_residual_json(), weights_h5=path)
+    x = jnp.ones((1, 16, 16, 3))
+    np.testing.assert_array_equal(
+        np.asarray(model.graph.apply(params, x)),
+        np.asarray(model2.graph.apply(loaded, x)),
+    )

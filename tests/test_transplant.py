@@ -32,20 +32,13 @@ def test_keras_round_trip_mobilenetv2():
 
 def test_keras_h5_round_trip(tmp_path):
     """Write a Keras-layout h5 and read it back via load_keras_h5."""
-    import h5py
+    from conftest import write_keras_h5
 
     model = get_model("vgg16")
     params = model.graph.init(jax.random.key(1), (1, 224, 224, 3))
     kw = export_keras_weights(model.graph, params)
     path = str(tmp_path / "w.h5")
-    with h5py.File(path, "w") as f:
-        f.attrs["layer_names"] = [n.encode() for n in kw]
-        for lname, arrays in kw.items():
-            g = f.create_group(lname)
-            wnames = [f"{lname}/w{i}".encode() for i in range(len(arrays))]
-            g.attrs["weight_names"] = wnames
-            for wn, a in zip(wnames, arrays):
-                g.create_dataset(wn.decode(), data=a)
+    write_keras_h5(path, kw)
     loaded = load_keras_h5(path)
     back = transplant(model.graph, params, KerasWeights(loaded))
     np.testing.assert_array_equal(
