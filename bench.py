@@ -85,6 +85,11 @@ def main() -> None:
     )
     log(f"pipeline: {n_stages} stage(s) over {n_dev} device(s), cuts={cuts}")
 
+    from defer_tpu.utils.profiling import TRACE_ENV, trace
+
+    if os.environ.get(TRACE_ENV):
+        log(f"device tracing enabled -> {os.environ[TRACE_ENV]}")
+
     best_ips = 0.0
     best_batch = None
     for batch in (1, 8, 32, 64):
@@ -107,9 +112,12 @@ def main() -> None:
             best_ips = stats["items_per_sec"]
             best_batch = batch
 
-    lat = pipe.probe_stage_latencies(
-        jnp.ones((best_batch, 224, 224, 3), jnp.float32), iters=10
-    )
+    # Per-stage latency probe, under a device trace when requested
+    # ($DEFER_TPU_TRACE=dir captures a TensorBoard profile of it).
+    with trace():
+        lat = pipe.probe_stage_latencies(
+            jnp.ones((best_batch, 224, 224, 3), jnp.float32), iters=10
+        )
     for r in lat:
         log(
             f"stage {r['stage']} p50 {r['p50_s'] * 1e3:.2f} ms "

@@ -33,6 +33,7 @@ from defer_tpu.models import Model
 from defer_tpu.parallel.mesh import pipeline_devices
 from defer_tpu.parallel.pipeline import Pipeline
 from defer_tpu.runtime.host_io import STOP, ProgressMonitor
+from defer_tpu.utils import profiling
 from defer_tpu.utils.logging import get_logger
 from defer_tpu.utils.sync import Retirer, hard_sync, hard_sync_timeout
 
@@ -122,7 +123,6 @@ class DEFER:
             model, partition_layers, params=params, rng=rng
         )
         monitor = ProgressMonitor(self.config.collective_timeout_s)
-        since_probe = 0
 
         def watchdog_sync(arr: Any) -> None:
             # Fetch-based barrier with a deadline so a stuck stage trips
@@ -155,6 +155,18 @@ class DEFER:
         # emitting results while the input queue idles — the reference's
         # feed and result paths are independent threads for the same
         # reason (src/dispatcher.py:93-118).
+        # Trace only a bounded window of the (potentially unbounded)
+        # serving loop — an open-ended trace grows without limit.
+        tracer = profiling.WindowTrace()
+        try:
+            self._stream_loop(
+                pipe, input_stream, emit, retirer, monitor, tracer
+            )
+        finally:
+            tracer.close()
+
+    def _stream_loop(self, pipe, input_stream, emit, retirer, monitor, tracer):
+        since_probe = 0
         while not self._stop.is_set():
             try:
                 item = input_stream.get(timeout=0.05)
@@ -165,6 +177,7 @@ class DEFER:
             if item is None or item is STOP:
                 break
             monitor.submitted()
+            tracer.tick()
             emit(retirer.add(pipe(item)))
             monitor.check()
             since_probe += 1

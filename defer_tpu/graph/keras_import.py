@@ -336,6 +336,12 @@ def from_keras_json(text: str | Mapping[str, Any]) -> tuple[Graph, tuple[int, ..
                 )
             shape = lcfg.get("batch_input_shape") or lcfg.get("batch_shape")
             if shape:
+                if any(d is None for d in shape[1:]):
+                    raise KerasImportError(
+                        f"InputLayer {name!r} has variable dims "
+                        f"{shape}: XLA needs static shapes — re-export "
+                        "the model with a concrete input size"
+                    )
                 input_shape = tuple(int(d) for d in shape[1:])
             produced[name] = b.input(name)
             continue
@@ -344,6 +350,12 @@ def from_keras_json(text: str | Mapping[str, Any]) -> tuple[Graph, tuple[int, ..
             raise KerasImportError(
                 f"unsupported Keras layer class {cls!r} (layer {name!r}); "
                 f"supported: {supported_layers()}"
+            )
+        if lcfg.get("data_format") == "channels_first":
+            raise KerasImportError(
+                f"layer {name!r} uses data_format='channels_first'; only "
+                "channels-last models are supported (the TPU-native layout "
+                "is NHWC)"
             )
         srcs = _inbound_names(layer.get("inbound_nodes"))
         if not srcs:

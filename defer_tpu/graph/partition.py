@@ -80,15 +80,33 @@ def articulation_points(graph: Graph) -> list[str]:
     lists were found by hand (reference src/test.py:24-28 documents
     them in a comment). A node c qualifies iff every edge leaving c's
     ancestor set originates at c itself.
+
+    Single O(V+E) sweep: for a valid c every node is comparable to c,
+    so anc(c) is exactly the topological prefix ending at c — c is
+    valid iff, right after processing it, every still-open edge (one
+    whose consumer hasn't been processed) originates at c. Edges into
+    dead nodes (non-ancestors of the output) are never consumed: such
+    a node lands on the far side of every later cut while its producer
+    stays on the near side, which is exactly the crossing edge the
+    ancestors-based definition rejects.
     """
-    edges = [(inp, n.name) for n in graph.nodes for inp in n.inputs]
+    live = graph.ancestors(graph.output_name)
+    consumers = graph.consumers()
+    open_out: dict[str, int] = {}
+    total_open = 0
     points: list[str] = []
     for node in graph.nodes:
-        if node.name in (graph.input_name, graph.output_name):
-            continue
-        anc = graph.ancestors(node.name)
-        if all(
-            u == node.name or u not in anc or v in anc for u, v in edges
+        if node.name in live:
+            for u in node.inputs:
+                open_out[u] -= 1
+                total_open -= 1
+        out_degree = len(consumers[node.name])
+        open_out[node.name] = out_degree
+        total_open += out_degree
+        if (
+            node.name in live
+            and node.name not in (graph.input_name, graph.output_name)
+            and total_open == out_degree
         ):
             points.append(node.name)
     return points

@@ -32,6 +32,7 @@ from defer_tpu.config import DeferConfig
 from defer_tpu.graph.ir import Graph, GraphParams
 from defer_tpu.graph.partition import stage_params
 from defer_tpu.utils.logging import get_logger
+from defer_tpu.utils.profiling import annotate
 from defer_tpu.utils.sync import Retirer, hard_sync
 
 log = get_logger(__name__)
@@ -100,9 +101,10 @@ class Pipeline:
         array is a future; block_until_ready() to wait)."""
         h = self._place(x, self.devices[0])
         for i, (fn, p) in enumerate(zip(self.stage_fns, self.stage_params)):
-            if i > 0:
-                h = self._place(h, self.devices[i])
-            h = fn(p, h)
+            with annotate(f"defer:stage{i}"):
+                if i > 0:
+                    h = self._place(h, self.devices[i])
+                h = fn(p, h)
         return h
 
     def stream(

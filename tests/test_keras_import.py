@@ -123,6 +123,44 @@ def test_articulation_points_superset_of_resnet_adds():
     assert "res2a_b_relu" not in pts  # inside a residual branch
 
 
+def test_articulation_points_match_naive_definition():
+    """The O(V+E) sweep must agree with the ancestors-based definition
+    node for node."""
+    for make in (
+        lambda: get_model("mobilenetv2").graph,
+        lambda: from_keras_json(_residual_json())[0],
+    ):
+        graph = make()
+        fast = set(articulation_points(graph))
+        edges = [(i, n.name) for n in graph.nodes for i in n.inputs]
+        naive = set()
+        for node in graph.nodes:
+            if node.name in (graph.input_name, graph.output_name):
+                continue
+            anc = graph.ancestors(node.name)
+            if all(
+                u == node.name or u not in anc or v in anc for u, v in edges
+            ):
+                naive.add(node.name)
+        assert fast == naive
+
+
+def test_channels_first_rejected():
+    bad = json.loads(_residual_json())
+    bad["config"]["layers"][2]["config"]["data_format"] = "channels_first"
+    with pytest.raises(KerasImportError, match="channels_first"):
+        from_keras_json(bad)
+
+
+def test_variable_input_dims_rejected():
+    bad = json.loads(_residual_json())
+    bad["config"]["layers"][0]["config"]["batch_input_shape"] = [
+        None, None, None, 3,
+    ]
+    with pytest.raises(KerasImportError, match="static shapes"):
+        from_keras_json(bad)
+
+
 def test_unsupported_layer_raises():
     bad = json.loads(_residual_json())
     bad["config"]["layers"][2]["class_name"] = "LocallyConnected2D"
