@@ -81,27 +81,32 @@ def articulation_points(graph: Graph) -> list[str]:
     them in a comment). A node c qualifies iff every edge leaving c's
     ancestor set originates at c itself.
 
-    Single O(V+E) sweep: for a valid c every node is comparable to c,
-    so anc(c) is exactly the topological prefix ending at c — c is
-    valid iff, right after processing it, every still-open edge (one
-    whose consumer hasn't been processed) originates at c. Edges into
-    dead nodes (non-ancestors of the output) are never consumed: such
-    a node lands on the far side of every later cut while its producer
-    stays on the near side, which is exactly the crossing edge the
-    ancestors-based definition rejects.
+    Candidates are restricted to ancestors of the output: a cut at a
+    node the output doesn't depend on would satisfy the raw edge
+    condition in degenerate graphs (a dead sink that consumes
+    everything) but partition() cannot build a stage chain from it, so
+    such nodes are excluded by design.
+
+    Single O(V+E) sweep: for a valid c every live node is comparable to
+    c, so anc(c) is exactly the topological prefix of live nodes ending
+    at c — c is valid iff, right after processing it, every still-open
+    edge (one whose consumer hasn't been processed) originates at c.
+    Edges into dead nodes are never consumed: a dead consumer lands on
+    the far side of every later cut while its producer stays on the
+    near side, which is exactly the crossing edge the ancestors-based
+    definition rejects.
     """
     live = graph.ancestors(graph.output_name)
     consumers = graph.consumers()
-    open_out: dict[str, int] = {}
     total_open = 0
     points: list[str] = []
     for node in graph.nodes:
         if node.name in live:
-            for u in node.inputs:
-                open_out[u] -= 1
-                total_open -= 1
+            total_open -= len(node.inputs)
+        # At this instant none of this node's own out-edges can have
+        # been consumed yet, so "every open edge originates here" is
+        # exactly total_open == out_degree.
         out_degree = len(consumers[node.name])
-        open_out[node.name] = out_degree
         total_open += out_degree
         if (
             node.name in live

@@ -73,6 +73,7 @@ class WindowTrace:
         self.limit = limit
         self.target = trace_dir or os.environ.get(TRACE_ENV)
         self._ticks = 0
+        self._cm = None  # the trace() context, entered on first tick
         self._active = False
         self._done = False
 
@@ -80,10 +81,9 @@ class WindowTrace:
         if not self.target or self._done:
             return
         if not self._active:
-            try:
-                jax.profiler.start_trace(self.target)
-            except Exception as e:
-                log.warning("profiler trace unavailable: %s", e)
+            self._cm = trace(self.target)
+            if self._cm.__enter__() is None:  # profiler unavailable
+                self._cm.__exit__(None, None, None)
                 self._done = True
                 return
             self._active = True
@@ -93,12 +93,6 @@ class WindowTrace:
 
     def close(self) -> None:
         if self._active:
-            try:
-                jax.profiler.stop_trace()
-                log.info(
-                    "wrote %d-step device trace to %s", self._ticks, self.target
-                )
-            except Exception as e:
-                log.warning("profiler stop failed: %s", e)
+            self._cm.__exit__(None, None, None)
             self._active = False
         self._done = True

@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Distributed pipeline-inference driver — the reference's `test.py`
+user model, TPU-native (reference src/test.py:20-58).
+
+Where the reference hard-codes two compute-node IPs and ships sub-models
+over sockets, this discovers the TPU slice and pins jit-compiled stages
+to cores; the queue-in/queue-out contract and the cut-list knob are
+unchanged, so a reference user's driver ports line for line.
+
+    python examples/distributed_infer.py --model resnet50 --minutes 1
+    python examples/distributed_infer.py --cuts add_2,add_4,add_6,add_8
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import jax
+
+# Honor an explicit platform choice even when site customization
+# pre-imported jax with another backend registered.
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import argparse
+import queue
+import threading
+import time
+
+import jax.numpy as jnp
+
+from defer_tpu.api import DEFER
+from defer_tpu.models import get_model
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet50")
+    ap.add_argument(
+        "--cuts",
+        default=None,
+        help="comma-separated cut layers (reference test.py's part_at); "
+        "default: one stage per visible device",
+    )
+    ap.add_argument("--minutes", type=float, default=5.0)
+    ap.add_argument("--batch", type=int, default=1)
+    args = ap.parse_args()
+
+    model = get_model(args.model)
+    n_dev = len(jax.devices())
+    cuts = (
+        args.cuts.split(",")
+        if args.cuts
+        else model.default_cuts(min(n_dev, len(model.cut_candidates) + 1))
+    )
+    print(f"{args.model}: {len(cuts) + 1} stages over {n_dev} device(s)")
+
+    defer = DEFER()
+    # The reference sizes these 10 deep for backpressure (test.py:44-45).
+    input_q: queue.Queue = queue.Queue(10)
+    output_q: queue.Queue = queue.Queue()
+    x = model.example_input(args.batch)
+
+    run_s = args.minutes * 60
+    start = time.time()
+
+    def print_result(q: queue.Queue) -> None:
+        res_count = 0
+        while q.get() is not None:
+            res_count += 1
+        images = res_count * args.batch
+        print(f"{res_count} results in {args.minutes} min")
+        print(f"Throughput: {images / (time.time() - start):.2f} images/sec")
+        if defer.last_stage_latencies:
+            for r in defer.last_stage_latencies:
+                print(
+                    f"  stage {r['stage']}: p50 {r['p50_s'] * 1e3:.2f} ms "
+                    f"p99 {r['p99_s'] * 1e3:.2f} ms"
+                )
+
+    a = threading.Thread(
+        target=defer.run_defer, args=(model, cuts, input_q, output_q),
+        daemon=True,
+    )
+    b = threading.Thread(target=print_result, args=(output_q,))
+    a.start()
+    b.start()
+
+    while (time.time() - start) < run_s:
+        input_q.put(x)  # blocks at depth 10 — backpressure, as in test.py:52
+    input_q.put(None)
+    # Join the pipeline thread before exiting: tearing the interpreter
+    # down mid-compile crashes XLA, and run_defer drains in-flight
+    # results on the way out.
+    a.join()
+    output_q.put(None)
+    b.join()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""Single-device baseline — the reference's `local_infer.py`, TPU-native
+(reference src/local_infer.py:16-23: loop model.predict, count results).
+
+This defines the denominator of every pipeline speedup claim.
+
+    python examples/local_infer.py --model resnet50 --minutes 1
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import jax
+
+# Honor an explicit platform choice even when site customization
+# pre-imported jax with another backend registered.
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import argparse
+
+from defer_tpu.api import run_local_inference
+from defer_tpu.models import get_model
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet50")
+    ap.add_argument("--minutes", type=float, default=10.0)
+    ap.add_argument("--batch", type=int, default=1)
+    args = ap.parse_args()
+
+    stats = run_local_inference(
+        get_model(args.model),
+        batch_size=args.batch,
+        duration_s=args.minutes * 60,
+    )
+    print(f"{stats['count']:.0f} results in {args.minutes} min")
+    print(f"Throughput: {stats['items_per_sec']:.2f} images/sec")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Distributed training example: one jitted step over a dp x pp x tp
+mesh (beyond the reference's inference-only scope, SURVEY.md §5).
+
+Run on hardware, or emulate a slice on CPU:
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/spmd_train.py --steps 5
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import jax
+
+# Honor an explicit platform choice even when site customization
+# pre-imported jax with another backend registered.
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import argparse
+import time
+
+import jax.numpy as jnp
+import optax
+
+from defer_tpu.models.bert import SpmdBert
+from defer_tpu.parallel.mesh import make_mesh
+from defer_tpu.parallel.train import make_train_step
+from defer_tpu.parallel.transformer_stack import TransformerConfig
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--stages", type=int, default=2)
+    ap.add_argument("--tp", type=int, default=2)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--dim", type=int, default=128)
+    ap.add_argument("--seq", type=int, default=64)
+    args = ap.parse_args()
+
+    n_dev = len(jax.devices())
+    dp = max(1, n_dev // (args.stages * args.tp))
+    mesh = make_mesh(
+        {"data": dp, "stage": args.stages, "model": args.tp},
+        jax.devices()[: dp * args.stages * args.tp],
+    )
+    cfg = TransformerConfig(
+        num_layers=args.layers,
+        dim=args.dim,
+        num_heads=4,
+        ffn_dim=4 * args.dim,
+        vocab_size=1024,
+        max_len=args.seq,
+    )
+    sb = SpmdBert(mesh, cfg)
+    init_state, train_step = make_train_step(
+        sb, optax.adamw(1e-3), num_classes=8
+    )
+    state = init_state(jax.random.key(0))
+
+    num_mb = args.stages + 2
+    batch = 4 * dp
+    key = jax.random.key(1)
+    print(f"mesh dp={dp} pp={args.stages} tp={args.tp}; "
+          f"{num_mb} microbatches of {batch}x{args.seq}")
+
+    t0 = time.perf_counter()
+    for step in range(args.steps):
+        key, k1, k2 = jax.random.split(key, 3)
+        ids = jax.random.randint(k1, (num_mb, batch, args.seq), 0, cfg.vocab_size)
+        labels = jax.random.randint(k2, (num_mb, batch), 0, 8)
+        state, loss = train_step(state, ids, labels)
+        if step in (0, args.steps - 1) or step % 10 == 0:
+            print(f"step {step}: loss {float(loss):.4f}")
+    dt = time.perf_counter() - t0
+    tokens = args.steps * num_mb * batch * args.seq
+    print(f"{tokens / dt:.0f} tokens/sec over {args.steps} steps")
+
+
+if __name__ == "__main__":
+    main()

@@ -133,9 +133,14 @@ def test_articulation_points_match_naive_definition():
         graph = make()
         fast = set(articulation_points(graph))
         edges = [(i, n.name) for n in graph.nodes for i in n.inputs]
+        live = graph.ancestors(graph.output_name)
         naive = set()
         for node in graph.nodes:
             if node.name in (graph.input_name, graph.output_name):
+                continue
+            # Candidates are restricted to ancestors of the output —
+            # partition() cannot chain stages through a dead node.
+            if node.name not in live:
                 continue
             anc = graph.ancestors(node.name)
             if all(
