@@ -47,9 +47,20 @@ class DeferConfig:
         return dataclasses.replace(self, **kw)
 
 
-def normalize_cuts(cuts: Sequence[str] | str | None) -> tuple[str, ...]:
+def normalize_cuts(
+    cuts: Sequence[str | Sequence[str]] | str | None,
+) -> tuple[str | tuple[str, ...], ...]:
+    """None -> (), "a" -> ("a",), and sequences pass through with list
+    bundles frozen to tuples (multi-tensor boundaries).
+
+    Note a top-level sequence is always a *list of cuts*: a single
+    bundle must be wrapped — pass [("h2", "h1")], not ("h2", "h1")
+    (the latter reads as two single-tensor cuts).
+    """
     if cuts is None:
         return ()
     if isinstance(cuts, str):
         return (cuts,)
-    return tuple(cuts)
+    return tuple(
+        tuple(c) if isinstance(c, (list, tuple)) else c for c in cuts
+    )

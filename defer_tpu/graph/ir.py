@@ -188,29 +188,9 @@ class Graph:
         """Run the graph. Single topological pass with a value cache —
         the memoized fix for the reference's exponential re-traversal of
         multi-path DAGs (reference src/dag_util.py:18-19)."""
-        from defer_tpu.ops import get_op
-
-        cache: dict[str, jax.Array] = {}
-        consumers_left = {
-            name: len(cons) for name, cons in self.consumers().items()
-        }
-        consumers_left[self.output_name] += 1  # never evict the output
-        for node in self.nodes:
-            if node.op == INPUT_OP:
-                cache[node.name] = x
-            else:
-                op = get_op(node.op)
-                inputs = [cache[i] for i in node.inputs]
-                cache[node.name] = op.apply(
-                    params.get(node.name, {}), inputs, node.attrs
-                )
-                # Free dead values eagerly so tracing giant graphs
-                # (NASNet) doesn't hold every intermediate alive.
-                for i in node.inputs:
-                    consumers_left[i] -= 1
-                    if consumers_left[i] == 0:
-                        del cache[i]
-        return cache[self.output_name]
+        return execute_nodes(
+            self.nodes, params, {self.input_name: x}, (self.output_name,)
+        )[self.output_name]
 
     def output_spec(
         self,
@@ -226,6 +206,47 @@ class Graph:
         return sum(
             leaf.size for leaf in jax.tree_util.tree_leaves(params)
         )
+
+
+def execute_nodes(
+    nodes: Sequence[OpNode],
+    params: GraphParams,
+    seeded: Mapping[str, jax.Array],
+    outputs: Sequence[str],
+) -> dict[str, jax.Array]:
+    """Topological walk shared by Graph.apply and multi-tensor stages
+    (defer_tpu/graph/partition.py): run `nodes` with `seeded` values
+    standing in for input placeholders, return the named `outputs`.
+
+    Dead intermediates are evicted eagerly so tracing giant graphs
+    (NASNet) doesn't hold every activation alive.
+    """
+    from defer_tpu.ops import get_op
+
+    cache: dict[str, jax.Array] = dict(seeded)
+    consumers_left: dict[str, int] = {n.name: 0 for n in nodes}
+    for n in nodes:
+        for i in n.inputs:
+            consumers_left[i] += 1
+    for o in outputs:
+        consumers_left[o] += 1  # never evict requested outputs
+    for node in nodes:
+        if node.op == INPUT_OP:
+            if node.name not in cache:
+                raise GraphError(
+                    f"no value seeded for input placeholder {node.name!r}"
+                )
+            continue
+        op = get_op(node.op)
+        inputs = [cache[i] for i in node.inputs]
+        cache[node.name] = op.apply(
+            params.get(node.name, {}), inputs, node.attrs
+        )
+        for i in node.inputs:
+            consumers_left[i] -= 1
+            if consumers_left[i] == 0:
+                del cache[i]
+    return {o: cache[o] for o in outputs}
 
 
 class GraphBuilder:

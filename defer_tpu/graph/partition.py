@@ -16,61 +16,140 @@ traversal. It has two defects this module fixes by construction:
 
 A graph cut at [c1, ..., cN] yields N+1 stages (reference
 src/dispatcher.py:33 loops len(cuts)+1 times the same way).
+
+**Multi-tensor boundaries** (beyond the reference): a cut may be a
+*tuple* of node names, meaning the pipeline relays that bundle of
+tensors across the boundary together. This is what makes NASNet-class
+graphs pipelinable at all — each cell consumes both its predecessor and
+pre-predecessor, so no single tensor separates the chain, but the pair
+(cell_i, cell_{i-1}) does. The reference cannot express this (its wire
+protocol ships exactly one activation per hop, reference
+src/node.py:125-133); here a boundary's stages exchange a tuple and
+stay jit-compiled end to end.
 """
 
 from __future__ import annotations
 
-from typing import Sequence
+import dataclasses
+from typing import Sequence, Union
 
-from defer_tpu.graph.ir import INPUT_OP, Graph, GraphParams, OpNode
+from defer_tpu.graph.ir import (
+    INPUT_OP,
+    Graph,
+    GraphParams,
+    OpNode,
+    execute_nodes,
+)
+
+# One boundary: a single articulation node, or a bundle of nodes whose
+# outputs jointly separate the chain.
+CutSpec = Union[str, Sequence[str]]
 
 
 class PartitionError(ValueError):
     pass
 
 
-def validate_cut_points(graph: Graph, cuts: Sequence[str]) -> None:
-    """Raise PartitionError unless every cut is a valid chain boundary.
+def _as_bundle(cut: CutSpec) -> tuple[str, ...]:
+    return (cut,) if isinstance(cut, str) else tuple(cut)
 
-    A cut node c is valid iff every edge (u -> v) with u on c's ancestor
-    side and v on the other side has u == c; then the only tensor
-    crossing the boundary is c's output, which is what the pipeline
-    relays to the next stage (the analogue of the single activation the
-    reference ships per hop, reference src/node.py:125-133).
+
+@dataclasses.dataclass(frozen=True)
+class StageGraph:
+    """A pipeline stage with a multi-tensor entry and/or exit.
+
+    Same execution contract as Graph.apply, but `apply` takes/returns a
+    tuple when the boundary carries more than one tensor. Single-tensor
+    boundaries keep plain arrays, so downstream code (device transfer,
+    donation, sync) treats both uniformly as pytrees.
+    """
+
+    name: str
+    nodes: tuple[OpNode, ...]
+    input_names: tuple[str, ...]
+    output_names: tuple[str, ...]
+
+    def apply(self, params: GraphParams, x):
+        xs = tuple(x) if isinstance(x, (tuple, list)) else (x,)
+        if len(xs) != len(self.input_names):
+            raise PartitionError(
+                f"stage {self.name!r} expects {len(self.input_names)} input "
+                f"tensors {self.input_names}, got {len(xs)}"
+            )
+        out = execute_nodes(
+            self.nodes, params, dict(zip(self.input_names, xs)),
+            self.output_names,
+        )
+        outs = tuple(out[o] for o in self.output_names)
+        return outs if len(outs) > 1 else outs[0]
+
+
+def _bundle_ancestors(graph: Graph, bundle: tuple[str, ...]) -> set[str]:
+    anc: set[str] = set()
+    for c in bundle:
+        anc |= graph.ancestors(c)
+    return anc
+
+
+def validate_cut_points(
+    graph: Graph, cuts: Sequence[CutSpec]
+) -> list[set[str]]:
+    """Raise PartitionError unless every cut is a valid chain boundary;
+    returns each boundary's ancestor set (reused by partition() so the
+    O(V+E) sweeps aren't repeated).
+
+    A boundary B (one node, or a bundle) is valid iff every edge
+    (u -> v) with u on B's ancestor side and v on the other side
+    originates at a member of B; then exactly the bundle's outputs cross
+    the boundary, which is what the pipeline relays to the next stage
+    (the analogue of the single activation the reference ships per hop,
+    reference src/node.py:125-133).
     """
     node_map = graph.node_map
-    seen: set[str] = set()
+    ancestor_sets: list[set[str]] = []
     prev_ancestors: set[str] = set()
     for cut in cuts:
-        if cut not in node_map:
-            raise PartitionError(
-                f"cut point {cut!r} is not a node of graph {graph.name!r}"
-            )
-        if cut in seen:
-            raise PartitionError(f"duplicate cut point {cut!r}")
-        seen.add(cut)
-        if cut in (graph.input_name, graph.output_name):
-            raise PartitionError(
-                f"cut point {cut!r} cannot be the graph input/output"
-            )
-        anc = graph.ancestors(cut)
+        bundle = _as_bundle(cut)
+        if not bundle:
+            raise PartitionError("empty cut bundle")
+        for c in bundle:
+            if c not in node_map:
+                raise PartitionError(
+                    f"cut point {c!r} is not a node of graph {graph.name!r}"
+                )
+            if c in (graph.input_name, graph.output_name):
+                raise PartitionError(
+                    f"cut point {c!r} cannot be the graph input/output"
+                )
+        if len(set(bundle)) != len(bundle):
+            raise PartitionError(f"duplicate node in cut bundle {bundle!r}")
+        anc = _bundle_ancestors(graph, bundle)
         if not prev_ancestors <= anc:
             raise PartitionError(
-                f"cut points must be in topological chain order; {cut!r} "
+                f"cut points must be in topological chain order; {bundle!r} "
                 "does not dominate the previous cut"
             )
+        if prev_ancestors >= anc:
+            raise PartitionError(
+                f"cut {bundle!r} adds no nodes beyond the previous "
+                "boundary — stages must be non-empty"
+            )
+        bundle_set = set(bundle)
         for node in graph.nodes:
             if node.name in anc:
                 continue
             for inp in node.inputs:
-                if inp in anc and inp != cut:
+                if inp in anc and inp not in bundle_set:
                     raise PartitionError(
-                        f"invalid cut at {cut!r}: edge {inp!r} -> "
-                        f"{node.name!r} crosses the boundary, so the cut is "
-                        "not a single-tensor articulation point (e.g. a cut "
-                        "inside a residual branch)"
+                        f"invalid cut at {bundle!r}: edge {inp!r} -> "
+                        f"{node.name!r} crosses the boundary, so the cut "
+                        "does not separate the chain (e.g. a cut inside a "
+                        f"residual branch). Add {inp!r} to the bundle or "
+                        "move the cut."
                     )
+        ancestor_sets.append(anc)
         prev_ancestors = anc
+    return ancestor_sets
 
 
 def articulation_points(graph: Graph) -> list[str]:
@@ -117,56 +196,71 @@ def articulation_points(graph: Graph) -> list[str]:
     return points
 
 
-def partition(graph: Graph, cuts: Sequence[str]) -> list[Graph]:
-    """Split `graph` at `cuts` into a chain of stage graphs.
+def partition(
+    graph: Graph, cuts: Sequence[CutSpec]
+) -> list[Graph | StageGraph]:
+    """Split `graph` at `cuts` into a chain of stages.
 
-    Stage i's input node keeps the *cut node's name* (op rewritten to
-    "input"), so parameters keep their global node-name keys and
-    `stage_params` is a plain dict slice.
+    Stage i's input placeholders keep the *cut nodes' names* (op
+    rewritten to "input"), so parameters keep their global node-name
+    keys and `stage_params` is a plain dict slice. Single-tensor
+    boundaries yield plain Graph stages; bundle boundaries yield
+    StageGraph stages whose apply exchanges tuples.
     """
-    cuts = list(cuts)
-    validate_cut_points(graph, cuts)
+    bundles = [_as_bundle(c) for c in cuts]
+    ancestor_sets = validate_cut_points(graph, bundles)
 
-    boundaries = [graph.input_name, *cuts]
+    entries = [(graph.input_name,), *bundles]
+    exits = [*bundles, (graph.output_name,)]
     segment_of: dict[str, int] = {}
     prev_anc: set[str] = set()
-    for i, cut in enumerate(cuts):
-        anc = graph.ancestors(cut)
+    for i, anc in enumerate(ancestor_sets):
         for name in anc - prev_anc:
             segment_of[name] = i
         prev_anc = anc
     for node in graph.nodes:
         if node.name not in segment_of:
-            segment_of[node.name] = len(cuts)
+            segment_of[node.name] = len(bundles)
 
-    stages: list[Graph] = []
-    for i in range(len(cuts) + 1):
-        entry = boundaries[i]
-        nodes: list[OpNode] = []
+    stages: list[Graph | StageGraph] = []
+    for i in range(len(bundles) + 1):
+        entry = entries[i]
+        entry_set = set(entry)
+        nodes: list[OpNode] = [OpNode(e, INPUT_OP, ()) for e in entry]
         for node in graph.nodes:
-            if segment_of[node.name] != i:
+            # Cut nodes belong to the producing segment (each is its own
+            # ancestor); the consuming stage sees them only as the
+            # placeholders created above.
+            if segment_of[node.name] != i or node.name in entry_set:
                 continue
-            if node.name == entry:
-                nodes.append(OpNode(entry, INPUT_OP, ()))
-            else:
-                nodes.append(node)
-        if i > 0 and not any(n.name == entry for n in nodes):
-            # The cut node was assigned to segment i-1 (it is its own
-            # ancestor); stage i still needs it as its input placeholder.
-            nodes.insert(0, OpNode(entry, INPUT_OP, ()))
-        out = cuts[i] if i < len(cuts) else graph.output_name
-        stages.append(
-            Graph(
-                name=f"{graph.name}.stage{i}",
-                nodes=tuple(nodes),
-                input_name=entry,
-                output_name=out,
+            nodes.append(node)
+        if len(entry) == 1 and len(exits[i]) == 1:
+            stages.append(
+                Graph(
+                    name=f"{graph.name}.stage{i}",
+                    nodes=tuple(nodes),
+                    input_name=entry[0],
+                    output_name=exits[i][0],
+                )
             )
-        )
+        else:
+            stages.append(
+                StageGraph(
+                    name=f"{graph.name}.stage{i}",
+                    nodes=tuple(nodes),
+                    input_names=entry,
+                    output_names=exits[i],
+                )
+            )
     return stages
 
 
-def stage_params(params: GraphParams, stage: Graph) -> dict:
-    """Slice the full parameter pytree down to one stage's nodes."""
-    names = {n.name for n in stage.nodes}
+def stage_params(params: GraphParams, stage: Graph | StageGraph) -> dict:
+    """Slice the full parameter pytree down to one stage's nodes.
+
+    Entry placeholders are excluded: a cut node's parameters live in
+    the stage that *computes* it — the consuming stage only receives
+    its activation, so shipping the weights there too would waste HBM.
+    """
+    names = {n.name for n in stage.nodes if n.op != INPUT_OP}
     return {k: v for k, v in params.items() if k in names and v}

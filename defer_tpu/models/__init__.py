@@ -35,7 +35,9 @@ class Model:
     graph: Graph
     input_shape: tuple[int, ...]  # without batch dim
     input_dtype: Any = jnp.float32
-    cut_candidates: tuple[str, ...] = ()
+    # Each candidate is one boundary: a node name, or a tuple of names
+    # for a multi-tensor bundle (NASNet's (cell_i, cell_i-1) pairs).
+    cut_candidates: tuple[str | tuple[str, ...], ...] = ()
 
     def init(
         self,
@@ -62,7 +64,7 @@ class Model:
             return jnp.zeros(shape, dtype)
         return jnp.ones(shape, dtype)
 
-    def default_cuts(self, num_stages: int) -> list[str]:
+    def default_cuts(self, num_stages: int) -> list[str | tuple[str, ...]]:
         if num_stages < 1:
             raise ValueError("num_stages must be >= 1")
         if num_stages == 1:

@@ -6,10 +6,14 @@ NASNet's cell i consumes BOTH cell i-1's and cell i-2's outputs (the
 `p` skip), so cell boundaries are NOT single-tensor articulation points:
 an edge from cell i-2 always crosses a cut placed after cell i-1. The
 reference's unvalidated traversal (reference src/dag_util.py:11-27)
-would silently duplicate whole cell subgraphs if cut there; our
-partitioner rejects such cuts, and `cut_candidates` lists the only
-honest ones — the stem conv output and the final-cell concat (whose `p`
-companion is dropped before the head).
+would silently duplicate whole cell subgraphs if cut there — and its
+one-activation-per-hop wire protocol couldn't ship the pair anyway
+(reference src/node.py:125-133). Here `cut_candidates` uses
+multi-tensor boundaries (defer_tpu/graph/partition.py): the bundle
+(cell_i, cell_{i-1}) jointly separates the chain at every cell, making
+NASNet fully pipelinable; the stem conv output and the final-cell
+concat (whose `p` companion is dropped before the head) stay
+single-tensor.
 
 Separable convs are composed from first-class `depthwise_conv` +
 pointwise `conv` ops (Keras's SeparableConv2D fused pair). Strided
@@ -208,7 +212,11 @@ def _build_nasnet(
     cuts: list[str] = [x]
 
     # Track (node, channels, spatial-halvings) so _adjust knows whether p
-    # needs the factorized reduction or just a channel projection.
+    # needs the factorized reduction or just a channel projection. Each
+    # inter-cell boundary carries the (cur, p) pair — collected as
+    # multi-tensor cut bundles.
+    pair_cuts: list[tuple[str, str]] = []
+
     def cell_chain():
         nonlocal x
         p, p_ch, p_lvl = None, stem_filters, 0
@@ -232,6 +240,7 @@ def _build_nasnet(
             # p for the next cell is this cell's *input*; after _adjust,
             # its channel count is f (or unchanged when p was None).
             p, p_ch, p_lvl = prev, prev_ch, prev_lvl
+            pair_cuts.append((cur, p))
 
         run("reduction", filters // 4, "stem_1")
         run("reduction", filters // 2, "stem_2")
@@ -246,6 +255,9 @@ def _build_nasnet(
         return cur
 
     x = cell_chain()
+    # Every inter-cell boundary is a valid (cur, p) bundle; after the
+    # final cell p is dropped, so that boundary is single-tensor.
+    cuts.extend(pair_cuts[:-1])
     cuts.append(x)  # final cell's concat: its p companion is dropped here
     x = b.add("relu", x, name="final_relu")
     x = b.add("global_avg_pool", x, name="global_average_pooling2d")

@@ -30,7 +30,7 @@ import jax.numpy as jnp
 
 from defer_tpu.config import DeferConfig
 from defer_tpu.graph.ir import Graph, GraphParams
-from defer_tpu.graph.partition import stage_params
+from defer_tpu.graph.partition import StageGraph, stage_params
 from defer_tpu.utils.logging import get_logger
 from defer_tpu.utils.profiling import annotate
 from defer_tpu.utils.sync import Retirer, hard_sync
@@ -43,7 +43,7 @@ class Pipeline:
 
     def __init__(
         self,
-        stages: Sequence[Graph],
+        stages: Sequence[Graph | StageGraph],
         params: GraphParams,
         devices: Sequence[jax.Device],
         config: DeferConfig | None = None,
@@ -70,8 +70,13 @@ class Pipeline:
 
             def stage_apply(p, x, _stage=stage, _cd=cd):
                 # Integer inputs (token ids) must keep their dtype.
-                if jnp.issubdtype(x.dtype, jnp.floating):
-                    x = x.astype(_cd)
+                # x may be a tuple (multi-tensor boundary).
+                x = jax.tree_util.tree_map(
+                    lambda a: a.astype(_cd)
+                    if jnp.issubdtype(a.dtype, jnp.floating)
+                    else a,
+                    x,
+                )
                 return _stage.apply(p, x)
 
             # Stage 0's input is caller-owned (device_put of an array
@@ -88,13 +93,17 @@ class Pipeline:
     # -- execution -------------------------------------------------------
 
     @staticmethod
-    def _place(x: Any, dev: jax.Device) -> jax.Array:
-        """device_put only when the array isn't already resident on
+    def _place(x: Any, dev: jax.Device) -> Any:
+        """device_put only when an array isn't already resident on
         `dev` — a redundant device_put of a host-uncommitted array
-        re-transfers the whole buffer from the host."""
-        if isinstance(x, jax.Array) and x.sharding.device_set == {dev}:
-            return x
-        return jax.device_put(x, dev)
+        re-transfers the whole buffer from the host. Tree-aware for
+        multi-tensor boundary tuples."""
+        return jax.tree_util.tree_map(
+            lambda a: a
+            if isinstance(a, jax.Array) and a.sharding.device_set == {dev}
+            else jax.device_put(a, dev),
+            x,
+        )
 
     def __call__(self, x: jax.Array) -> jax.Array:
         """Push one microbatch through the chain (async — the returned

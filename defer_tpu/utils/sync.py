@@ -25,10 +25,11 @@ import jax
 import numpy as np
 
 
-def hard_sync(*arrays: jax.Array) -> None:
-    """Block until every given array's computation has truly completed
-    (fetch one element as a ground-truth barrier)."""
-    for arr in arrays:
+def hard_sync(*arrays: Any) -> None:
+    """Block until every given value's computation has truly completed
+    (fetch one element per leaf as a ground-truth barrier). Accepts
+    pytrees — multi-tensor pipeline boundaries pass activation tuples."""
+    for arr in jax.tree_util.tree_leaves(arrays):
         if getattr(arr, "ndim", 0) > 0 and arr.size > 1:
             np.asarray(arr.ravel()[-1:])
         else:

@@ -78,16 +78,36 @@ def test_new_zoo_cuts_are_valid(name):
         validate_cut_points(model.graph, cuts)
 
 
-def test_nasnet_has_only_honest_cuts():
+def test_nasnet_pipelinable_via_multi_tensor_bundles():
     """NASNet's p-skip makes cell boundaries non-articulation points;
-    the model must advertise only genuinely valid cuts."""
+    the (cell_i, cell_i-1) bundles make every boundary cuttable."""
     model = get_model("nasnet_mobile")
-    validate_cut_points(model.graph, model.default_cuts(
-        len(model.cut_candidates) + 1))
-    # A cell output mid-chain is NOT valid (its p companion crosses).
+    # 4 + 3*num_blocks cells -> one boundary per cell (last is single).
+    assert len(model.cut_candidates) == 2 + 15
+    for n in (2, 8, len(model.cut_candidates) + 1):
+        validate_cut_points(model.graph, model.default_cuts(n))
+    # A bare cell output mid-chain is still NOT valid on its own.
     from defer_tpu.graph.partition import PartitionError
     with pytest.raises(PartitionError):
         validate_cut_points(model.graph, ["cell_2"])
+
+
+def test_nasnet_multi_cut_partition_composes():
+    """Composed bundle stages must equal the unpartitioned forward."""
+    import jax.numpy as jnp
+
+    from defer_tpu.graph.partition import partition, stage_params
+
+    model = get_model("nasnet_mobile")
+    shape = (1, 64, 64, 3)
+    params = model.graph.init(jax.random.key(4), shape)
+    x = jax.random.normal(jax.random.key(5), shape)
+    full = model.graph.apply(params, x)
+    stages = partition(model.graph, model.default_cuts(4))
+    y = x
+    for st in stages:
+        y = st.apply(stage_params(params, st), y)
+    assert jnp.allclose(full, y, atol=1e-5), float(jnp.max(jnp.abs(full - y)))
 
 
 def test_mobilenetv2_partition_composes():

@@ -39,7 +39,7 @@ def test_valid_cuts_pass():
 def test_cut_inside_residual_branch_rejected():
     """The reference silently miscompiles this case (SURVEY.md §3.4)."""
     g = residual_chain()
-    with pytest.raises(PartitionError, match="articulation"):
+    with pytest.raises(PartitionError, match="crosses the boundary"):
         validate_cut_points(g, ["blk1_relu"])
 
 
@@ -47,7 +47,9 @@ def test_unknown_and_duplicate_and_boundary_cuts_rejected():
     g = residual_chain()
     with pytest.raises(PartitionError, match="not a node"):
         validate_cut_points(g, ["nope"])
-    with pytest.raises(PartitionError, match="duplicate"):
+    # A repeated cut adds no nodes to the chain — rejected as an empty
+    # stage rather than as a literal duplicate.
+    with pytest.raises(PartitionError, match="adds no nodes"):
         validate_cut_points(g, ["add_1", "add_1"])
     with pytest.raises(PartitionError, match="input/output"):
         validate_cut_points(g, ["input"])
@@ -118,3 +120,118 @@ def test_stage_params_partition_params_exactly():
     parameterized = {k for k, v in params.items() if v}
     assert set(p0) | set(p1) == parameterized
     assert not set(p0) & set(p1)
+
+
+# -- multi-tensor boundaries ------------------------------------------------
+
+
+def skip_chain():
+    """NASNet-shaped skeleton: block k consumes outputs k-1 AND k-2, so
+    no single tensor separates the chain but (h_k, h_{k-1}) does."""
+    b = GraphBuilder("skip")
+    x = b.input()
+    h_prev = b.add("dense", x, name="h0", features=8)
+    h = b.add("dense", h_prev, name="h1", features=8)
+    for i in range(2, 5):
+        nxt = b.add("add", h, h_prev, name=f"mix{i}")
+        nxt = b.add("dense", nxt, name=f"h{i}", features=8)
+        h_prev, h = h, nxt
+    out = b.add("dense", h, name="head", features=4)
+    return b.build(out)
+
+
+def test_single_cut_on_skip_chain_rejected():
+    g = skip_chain()
+    with pytest.raises(PartitionError, match="crosses the boundary"):
+        validate_cut_points(g, ["h2"])
+
+
+def test_bundle_cut_on_skip_chain_validates_and_composes():
+    g = skip_chain()
+    cuts = [("h2", "h1"), ("h4", "h3")]
+    validate_cut_points(g, cuts)
+    stages = partition(g, cuts)
+    assert len(stages) == 3
+    params = g.init(jax.random.key(0), (2, 8))
+    x = jax.random.normal(jax.random.key(1), (2, 8))
+    full = g.apply(params, x)
+    y = x
+    for st in stages:
+        y = st.apply(stage_params(params, st), y)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(full), rtol=1e-6)
+
+
+def test_bundle_passthrough_across_boundaries():
+    """A tensor consumed two boundaries later rides through the middle
+    stage as an input that is also an output."""
+    b = GraphBuilder("pass")
+    x = b.input()
+    a = b.add("dense", x, name="a", features=8)
+    m = b.add("dense", a, name="mid", features=8)
+    m2 = b.add("dense", m, name="mid2", features=8)
+    out = b.add("add", m2, a, name="join")
+    g = b.build(b.add("dense", out, name="head", features=4))
+    cuts = [("mid", "a"), ("mid2", "a")]
+    validate_cut_points(g, cuts)
+    stages = partition(g, cuts)
+    params = g.init(jax.random.key(2), (3, 8))
+    x_in = jax.random.normal(jax.random.key(3), (3, 8))
+    full = g.apply(params, x_in)
+    y = x_in
+    for st in stages:
+        y = st.apply(stage_params(params, st), y)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(full), rtol=1e-6)
+
+
+def test_bundle_missing_member_rejected_with_hint():
+    g = skip_chain()
+    with pytest.raises(PartitionError, match="Add .* to the bundle"):
+        validate_cut_points(g, [("h2",)])
+
+
+def test_empty_and_degenerate_bundles_rejected():
+    g = skip_chain()
+    with pytest.raises(PartitionError, match="empty cut bundle"):
+        validate_cut_points(g, [()])
+    with pytest.raises(PartitionError, match="duplicate node"):
+        validate_cut_points(g, [("h2", "h2")])
+    with pytest.raises(PartitionError, match="adds no nodes"):
+        validate_cut_points(g, [("h2", "h1"), ("h2", "h1")])
+
+
+def test_bundle_pipeline_on_devices(devices):
+    """Bundle boundaries flow as tuples through the device-pinned
+    pipeline (device_put/donation/sync on pytrees)."""
+    from defer_tpu.config import DeferConfig
+    from defer_tpu.parallel.pipeline import Pipeline
+
+    g = skip_chain()
+    cuts = [("h2", "h1"), ("h4", "h3")]
+    stages = partition(g, cuts)
+    params = g.init(jax.random.key(4), (2, 8))
+    pipe = Pipeline(
+        stages, params, devices[:3], DeferConfig(compute_dtype=jnp.float32)
+    )
+    x = jax.random.normal(jax.random.key(5), (2, 8))
+    out = pipe.warmup(x)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(g.apply(params, x)), rtol=1e-6
+    )
+    outs = list(pipe.stream([x, x, x]))
+    assert len(outs) == 3
+
+
+def test_bundle_stage_params_stay_disjoint():
+    """Cut-node weights belong only to the producing stage, even though
+    the consuming stage names the cut node as its input placeholder."""
+    g = skip_chain()
+    stages = partition(g, [("h2", "h1")])
+    params = g.init(jax.random.key(6), (2, 8))
+    slices = [stage_params(params, st) for st in stages]
+    for a in range(len(slices)):
+        for b in range(a + 1, len(slices)):
+            overlap = set(slices[a]) & set(slices[b])
+            assert not overlap, overlap
+    # Every param-bearing node lands in exactly one slice.
+    owned = set().union(*(set(s) for s in slices))
+    assert owned == {k for k, v in params.items() if v}
