@@ -65,7 +65,7 @@ class DEFER:
     def build_pipeline(
         self,
         model: Model | Graph,
-        partition_layers: Sequence[str] | str | None,
+        partition_layers: Sequence[str | Sequence[str]] | str | None,
         *,
         params: GraphParams | None = None,
         rng: jax.Array | None = None,
@@ -106,7 +106,7 @@ class DEFER:
     def run_defer(
         self,
         model: Model | Graph,
-        partition_layers: Sequence[str] | str | None,
+        partition_layers: Sequence[str | Sequence[str]] | str | None,
         input_stream: "queue.Queue[Any]",
         output_stream: "queue.Queue[Any]",
         *,

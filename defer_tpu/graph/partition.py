@@ -108,6 +108,7 @@ def validate_cut_points(
     node_map = graph.node_map
     ancestor_sets: list[set[str]] = []
     prev_ancestors: set[str] = set()
+    prev_bundle: set[str] = set()
     for cut in cuts:
         bundle = _as_bundle(cut)
         if not bundle:
@@ -134,6 +135,15 @@ def validate_cut_points(
                 f"cut {bundle!r} adds no nodes beyond the previous "
                 "boundary — stages must be non-empty"
             )
+        for c in bundle:
+            # A member computed before the previous boundary is only
+            # available here if the previous boundary relayed it.
+            if c in prev_ancestors and c not in prev_bundle:
+                raise PartitionError(
+                    f"bundle member {c!r} is computed before the previous "
+                    f"boundary but not carried across it; add {c!r} to the "
+                    "previous bundle so its activation is relayed through"
+                )
         bundle_set = set(bundle)
         for node in graph.nodes:
             if node.name in anc:
@@ -149,6 +159,7 @@ def validate_cut_points(
                     )
         ancestor_sets.append(anc)
         prev_ancestors = anc
+        prev_bundle = set(bundle)
     return ancestor_sets
 
 

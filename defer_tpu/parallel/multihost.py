@@ -1,0 +1,108 @@
+"""Multi-host (multi-process) initialization and mesh layout.
+
+The reference scales out with an IP list and raw sockets (reference
+src/test.py:20, src/node_state.py:43-101). The TPU-native equivalent is
+`jax.distributed`: every host runs the same SPMD program, the JAX
+runtime wires the slice(s), and XLA routes collectives over ICI within
+a slice and DCN across slices. This module wraps that bootstrap and
+encodes the one layout rule that matters for performance: **axes that
+communicate most must stay inside a slice (ICI); only the outermost
+data/pipeline axes may span slices (DCN)** — the scaling-book recipe.
+
+For pipelines spanning hosts outside one jax.distributed job (the
+reference's heterogeneous-edge deployment model), the host relay in
+defer_tpu/runtime/transport.py carries boundary activations instead.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Mapping, Sequence
+
+import jax
+
+from defer_tpu.parallel.mesh import make_mesh
+from defer_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> dict:
+    """Join (or bootstrap) a multi-host JAX job.
+
+    On TPU pods with standard env metadata, bare `initialize()`
+    auto-discovers everything; the explicit arguments cover DCN
+    clusters without that metadata — the analogue of the reference
+    telling every node its peers by hand (reference src/test.py:20),
+    but once, at startup, instead of per-edge socket wiring.
+
+    Returns the resulting topology snapshot. Safe to call in
+    single-process runs (no coordinator configured -> no-op).
+    """
+    explicit = coordinator_address is not None
+    discovered = any(
+        v in os.environ
+        for v in ("COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS")
+    )
+    if explicit or discovered or jax.process_count() > 1:
+        if jax.process_count() == 1 or explicit:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+    else:
+        log.info("single-process run; jax.distributed not initialized")
+    topo = {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": jax.local_device_count(),
+        "global_devices": jax.device_count(),
+    }
+    log.info("multihost topology: %s", topo)
+    return topo
+
+
+def dcn_aware_axes(
+    axes: Mapping[str, int], *, dcn_axes: Sequence[str] = ("data", "stage")
+) -> dict[str, int]:
+    """Order mesh axes so only the named outer axes cross hosts.
+
+    jax.sharding.Mesh maps leading axes to the outermost device
+    dimension; with `jax.devices()` ordering, devices of one host are
+    contiguous, so the leading axes are the ones that span hosts. Axes
+    with heavy collectives (model/tensor, sequence, expert) must stay
+    inner so their traffic rides ICI; data and pipeline-stage traffic
+    is per-step and small (one activation boundary), so those may
+    cross DCN.
+    """
+    hosts = jax.process_count()
+    if hosts <= 1:
+        return dict(axes)
+    outer = {k: v for k, v in axes.items() if k in dcn_axes}
+    inner = {k: v for k, v in axes.items() if k not in dcn_axes}
+    outer_size = 1
+    for v in outer.values():
+        outer_size *= v
+    if outer_size % hosts != 0 and outer_size != 1:
+        log.warning(
+            "outer axes %s (size %d) do not tile the %d hosts evenly; "
+            "an ICI-heavy axis may end up crossing DCN",
+            tuple(outer),
+            outer_size,
+            hosts,
+        )
+    return {**outer, **inner}
+
+
+def make_multihost_mesh(
+    axes: Mapping[str, int],
+    *,
+    dcn_axes: Sequence[str] = ("data", "stage"),
+):
+    """make_mesh with the DCN-aware axis ordering applied."""
+    return make_mesh(dcn_aware_axes(axes, dcn_axes=dcn_axes))

@@ -37,6 +37,7 @@ log = get_logger(__name__)
 
 _MAGIC = b"DC"
 _VERSION = 1
+SCHEME_RAW = 0  # passthrough (level=0): fast links where codec loses
 SCHEME_ZSTD_SHUFFLE = 1  # native codec
 SCHEME_ZLIB_SHUFFLE = 2  # pure-python fallback
 
@@ -109,7 +110,9 @@ def _unshuffle_np(raw: bytes, elem: int) -> bytes:
 
 
 def encode(arr: np.ndarray, *, level: int = 3) -> bytes:
-    """Array -> self-describing compressed frame."""
+    """Array -> self-describing compressed frame. level=0 skips
+    compression entirely (raw passthrough for links where the codec
+    costs more than the bytes it saves)."""
     arr = np.ascontiguousarray(arr)
     raw = arr.tobytes()
     elem = arr.dtype.itemsize
@@ -117,7 +120,9 @@ def encode(arr: np.ndarray, *, level: int = 3) -> bytes:
 
     payload = None
     scheme = SCHEME_ZLIB_SHUFFLE
-    lib = load_native()
+    if level == 0:
+        payload, scheme = raw, SCHEME_RAW
+    lib = load_native() if payload is None else None
     if lib is not None and raw:
         cap = lib.defer_codec_bound(len(raw))
         dst = ctypes.create_string_buffer(cap)
@@ -160,7 +165,11 @@ def decode(frame: bytes) -> np.ndarray:
     nbytes = max(nbytes, 0)
     elem = dtype.itemsize
 
-    if scheme == SCHEME_ZSTD_SHUFFLE:
+    if scheme == SCHEME_RAW:
+        if len(payload) != nbytes:
+            raise ValueError("corrupt raw codec frame")
+        raw = payload
+    elif scheme == SCHEME_ZSTD_SHUFFLE:
         lib = load_native()
         if lib is None:
             raise RuntimeError(

@@ -1,0 +1,167 @@
+"""Host-to-host activation transport for the DCN / cross-slice path.
+
+Inside one slice, stage-to-stage traffic rides ICI via device transfers
+(defer_tpu/parallel/pipeline.py) or XLA collectives — no host code. But
+a pipeline spanning *slices* (or heterogeneous hosts, the reference's
+whole deployment model) needs a host relay. This module is that seam,
+rebuilt from the reference's hand-rolled socket layer (reference
+src/node_state.py:43-101: 8-byte big-endian length framing, 512 KB
+chunks, select() on EAGAIN) with the parts that were wrong or missing
+fixed:
+
+  * framing: length-prefixed, but over a blocking socket with
+    sendall/recv_into — the reference's non-blocking + select loop
+    burns CPU for no benefit on a dedicated relay thread;
+  * payloads: arrays go through the native byteshuffle+zstd codec
+    (defer_tpu/runtime/codec.py) exactly where the reference ran
+    ZFP+LZ4 (reference src/dispatcher.py:89-92), toggleable per link
+    since DCN is fast enough that compression can lose;
+  * shutdown: explicit STOP frame and timeouts — the reference hangs
+    forever when a peer dies (reference src/node.py:102-103).
+
+Wire format per frame: 1-byte tag ('A' array / 'S' stop), 8-byte
+big-endian payload length, payload bytes (a codec frame for arrays).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Iterator
+
+import numpy as np
+
+from defer_tpu.runtime import codec
+from defer_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+_TAG_ARRAY = b"A"
+_TAG_STOP = b"S"
+_HEADER = struct.Struct(">cQ")
+
+
+class TransportError(ConnectionError):
+    pass
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise TransportError("peer closed mid-frame")
+        got += r
+    return bytes(buf)
+
+
+class ArraySender:
+    """Client side: connect to a peer relay and stream arrays.
+
+    The analogue of the reference's `_data_client` (reference
+    src/node.py:113-133), minus the polling sleep loop.
+    """
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        *,
+        compress: bool = True,
+        level: int = 3,
+        connect_timeout_s: float = 30.0,
+        retries: int = 10,
+    ):
+        self.compress = compress
+        self.level = level
+        last: Exception | None = None
+        for attempt in range(retries):
+            try:
+                self._sock = socket.create_connection(
+                    (host, port), timeout=connect_timeout_s
+                )
+                break
+            except OSError as e:
+                last = e
+                threading.Event().wait(min(0.1 * 2**attempt, 2.0))
+        else:
+            raise TransportError(
+                f"could not connect to {host}:{port}: {last}"
+            )
+        self._sock.settimeout(None)
+        self._lock = threading.Lock()
+
+    def send(self, arr: np.ndarray) -> None:
+        # level=0 is the codec's raw-passthrough scheme.
+        frame = codec.encode(
+            np.asarray(arr), level=self.level if self.compress else 0
+        )
+        with self._lock:
+            self._sock.sendall(_HEADER.pack(_TAG_ARRAY, len(frame)) + frame)
+
+    def close(self) -> None:
+        """Send the STOP frame (the graceful shutdown the reference
+        lacks) and close."""
+        try:
+            with self._lock:
+                self._sock.sendall(_HEADER.pack(_TAG_STOP, 0))
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class ArrayReceiver:
+    """Server side: accept one peer and iterate received arrays.
+
+    The analogue of the reference's `_data_server` (reference
+    src/node.py:97-111). `accept_timeout_s` bounds the wait for the
+    peer; the reference blocks forever (reference src/node.py:103).
+    """
+
+    def __init__(
+        self,
+        port: int,
+        *,
+        host: str = "0.0.0.0",
+        accept_timeout_s: float = 120.0,
+    ):
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind((host, port))
+        self._server.listen(1)
+        self._server.settimeout(accept_timeout_s)
+        self.port = self._server.getsockname()[1]
+        self._conn: socket.socket | None = None
+
+    def _accept(self) -> socket.socket:
+        if self._conn is None:
+            try:
+                self._conn, peer = self._server.accept()
+            except socket.timeout:
+                raise TransportError(
+                    "no peer connected within the accept timeout"
+                ) from None
+            self._conn.settimeout(None)
+            log.info("transport: accepted peer %s", peer)
+        return self._conn
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        conn = self._accept()
+        while True:
+            tag, length = _HEADER.unpack(_recv_exact(conn, _HEADER.size))
+            if tag == _TAG_STOP:
+                return
+            if tag != _TAG_ARRAY:
+                raise TransportError(f"unknown frame tag {tag!r}")
+            yield codec.decode(_recv_exact(conn, length))
+
+    def close(self) -> None:
+        for s in (self._conn, self._server):
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass

@@ -235,3 +235,16 @@ def test_bundle_stage_params_stay_disjoint():
     # Every param-bearing node lands in exactly one slice.
     owned = set().union(*(set(s) for s in slices))
     assert owned == {k for k, v in params.items() if v}
+
+
+def test_bundle_member_not_carried_forward_rejected():
+    """A later bundle may not name a tensor the previous boundary
+    didn't relay (it was computed upstream and is unavailable)."""
+    b = GraphBuilder("lin")
+    x = b.input()
+    a = b.add("dense", x, name="a", features=8)
+    bb = b.add("dense", a, name="b", features=8)
+    c = b.add("dense", bb, name="c", features=8)
+    g = b.build(b.add("dense", c, name="head", features=4))
+    with pytest.raises(PartitionError, match="not carried across"):
+        validate_cut_points(g, [("b",), ("c", "a")])
