@@ -60,6 +60,11 @@ def cpu_baseline_subprocess(duration_s: float = 6.0) -> float:
 
 def main() -> None:
     import jax
+
+    # Honor an explicit platform choice even when site customization
+    # pre-imported jax with another backend registered.
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     import jax.numpy as jnp
 
     from defer_tpu.config import DeferConfig

@@ -1,0 +1,153 @@
+"""Host input pipeline: preprocessing + device prefetch.
+
+The reference's drivers load and preprocess one image with PIL on the
+host and re-feed the same tensor forever (reference src/test.py:13-16,
+src/local_infer.py:10-14). Here the host side of the feed is a real
+component:
+
+  * `imagenet_preprocess` — the zoo models' input transform (resize,
+    center-crop, scale) on host numpy arrays, batched.
+  * `batched` — group an example stream into fixed-size batches (the
+    pipeline needs static shapes; a short tail batch is dropped by
+    default, XLA would otherwise recompile).
+  * `prefetch_to_device` — a bounded background thread that stages
+    `device_put` ahead of consumption, overlapping host→device
+    transfer with device compute (the reference's decoupled feed
+    thread, reference src/dispatcher.py:99-103, minus the socket).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Iterable, Iterator, Sequence
+
+import jax
+import numpy as np
+
+from defer_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+def imagenet_preprocess(
+    images: np.ndarray,
+    *,
+    size: int = 224,
+    mode: str = "scale",
+) -> np.ndarray:
+    """uint8/float HWC (or NHWC) images -> float32 NHWC model input.
+
+    mode="scale": x/127.5 - 1 (the MobileNet/Inception/EfficientNet
+    family convention). mode="caffe": BGR mean subtraction (ResNet50/
+    VGG Keras weights convention).
+    """
+    x = np.asarray(images)
+    if x.ndim == 3:
+        x = x[None]
+    if x.ndim != 4:
+        raise ValueError(f"expected HWC or NHWC images, got shape {x.shape}")
+    x = x.astype(np.float32)
+    if x.shape[1] != size or x.shape[2] != size:
+        x = _resize_center_crop(x, size)
+    if mode == "scale":
+        return x / 127.5 - 1.0
+    if mode == "caffe":
+        # RGB -> BGR, subtract ImageNet channel means.
+        return x[..., ::-1] - np.array([103.939, 116.779, 123.68], np.float32)
+    raise ValueError(f"unknown preprocess mode {mode!r}")
+
+
+def _resize_center_crop(x: np.ndarray, size: int) -> np.ndarray:
+    """Resize the short side to `size`, then center-crop to size x size
+    (bilinear, via jax.image on host)."""
+    n, h, w, c = x.shape
+    scale = size / min(h, w)
+    nh, nw = max(size, round(h * scale)), max(size, round(w * scale))
+    # Pin to the CPU backend: this is host-side work and must not
+    # compete with (or round-trip through) the accelerator the
+    # pipeline stages run on.
+    with jax.default_device(jax.local_devices(backend="cpu")[0]):
+        resized = np.asarray(
+            jax.image.resize(x, (n, nh, nw, c), method="bilinear")
+        )
+    top, left = (nh - size) // 2, (nw - size) // 2
+    return resized[:, top : top + size, left : left + size, :]
+
+
+def batched(
+    examples: Iterable[np.ndarray],
+    batch_size: int,
+    *,
+    drop_remainder: bool = True,
+) -> Iterator[np.ndarray]:
+    """Stack an example stream into fixed-size batches (static shapes —
+    a ragged tail batch would force an XLA recompile)."""
+    buf: list[np.ndarray] = []
+    for ex in examples:
+        buf.append(np.asarray(ex))
+        if len(buf) == batch_size:
+            yield np.stack(buf)
+            buf = []
+    if buf and not drop_remainder:
+        yield np.stack(buf)
+    elif buf:
+        log.info("batched: dropped %d-example tail batch", len(buf))
+
+
+_STOP = object()
+
+
+def prefetch_to_device(
+    it: Iterable[Any],
+    device: jax.Device | None = None,
+    *,
+    depth: int = 2,
+) -> Iterator[jax.Array]:
+    """Iterate `it`, staging device_put `depth` items ahead in a
+    background thread. Exceptions from the source iterator re-raise at
+    the consumption point; the thread always terminates."""
+    dev = device or jax.devices()[0]
+    q: "queue.Queue[Any]" = queue.Queue(maxsize=depth)
+    abandoned = threading.Event()
+
+    def _put(item: Any) -> bool:
+        """put that gives up when the consumer is gone, so the feeder
+        thread (and the source iterator + staged device buffers it
+        holds) always terminates."""
+        while not abandoned.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def feed() -> None:
+        try:
+            for item in it:
+                if not _put(jax.device_put(item, dev)):
+                    return
+        except BaseException as e:  # noqa: BLE001 — relayed to consumer
+            _put(("__error__", e))
+            return
+        _put(_STOP)
+
+    t = threading.Thread(target=feed, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _STOP:
+                return
+            if (
+                isinstance(item, tuple)
+                and len(item) == 2
+                and item[0] == "__error__"
+            ):
+                raise item[1]
+            yield item
+    finally:
+        # Runs on normal exhaustion, consumer error, or GeneratorExit
+        # (abandoned partial read) — unblocks the feeder either way.
+        abandoned.set()

@@ -7,6 +7,7 @@ import threading
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from defer_tpu import DEFER, DeferConfig, run_local_inference
 from defer_tpu.models import get_model
@@ -84,3 +85,35 @@ class _Tiny:
 
     def init(self, rng, **kw):
         return self._model.init(rng, **kw)
+
+
+def test_stage_failure_surfaces_cleanly(devices):
+    """Fault injection: a stage whose op raises must propagate an
+    exception out of run_defer instead of hanging (the reference hangs
+    forever on node death, reference src/node.py:102-103)."""
+    from defer_tpu.graph.ir import GraphBuilder
+    from defer_tpu.ops.registry import op_names, register_op
+
+    if "explode" not in op_names():
+        @register_op("explode")
+        def explode_apply(params, inputs, attrs):
+            # Stands in for any stage-side failure (bad op config,
+            # shape bug, OOM).
+            raise RuntimeError("injected stage failure")
+
+    b = GraphBuilder("faulty")
+    x = b.input()
+    h = b.add("dense", x, name="s0", features=4)
+    h = b.add("explode", h, name="boom")
+    g = b.build(h)
+
+    defer = DEFER(devices[:2])
+    inq, outq = queue.Queue(), queue.Queue()
+    inq.put(jnp.ones((2, 8)))
+    with pytest.raises(Exception, match="injected stage failure"):
+        defer.run_defer(
+            g, ["s0"], inq, outq,
+            params={"input": {}, "boom": {},
+                    "s0": {"kernel": jnp.ones((8, 4)),
+                           "bias": jnp.zeros(4)}},
+        )
