@@ -61,8 +61,12 @@ def cpu_baseline_subprocess(duration_s: float = 6.0) -> float:
 def main() -> None:
     import jax
 
-    # Honor an explicit platform choice even when site customization
-    # pre-imported jax with another backend registered.
+    # Honor an explicit platform choice. The env default alone is not
+    # enough here: this machine's site customization pre-imports jax
+    # and forces its platform via config.update, which overrides the
+    # env-derived default — so we override back, before first backend
+    # use. (Verified empirically: without this, JAX_PLATFORMS=cpu runs
+    # still initialized the site platform.)
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     import jax.numpy as jnp

@@ -22,6 +22,7 @@ import os
 import struct
 from typing import Any, Mapping
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -94,3 +95,38 @@ def load_checkpoint(path: str) -> dict:
             value = jnp.asarray(arr)
             items.append((e["key"], value))
     return _unflatten(items)
+
+
+# -- arbitrary pytrees (train states: params + optimizer + step) -----------
+
+
+def save_pytree(path: str, tree: Any, *, level: int = 3) -> None:
+    """Checkpoint any pytree (e.g. a TrainState: params dict + optax
+    opt_state NamedTuples + step counter) by flattening to leaves.
+
+    The tree *structure* is not serialized — restore requires a
+    template with the same structure (`load_pytree`), which every
+    training setup can rebuild via its init function. This is the
+    standard resume pattern and keeps the on-disk format plain arrays.
+    """
+    leaves = jax.tree_util.tree_leaves(tree)
+    save_checkpoint(
+        path,
+        {"__leaves__": {str(i): leaf for i, leaf in enumerate(leaves)}},
+        level=level,
+    )
+
+
+def load_pytree(path: str, template: Any) -> Any:
+    """Restore a pytree saved by save_pytree into `template`'s
+    structure (values of `template` are ignored; shapes/dtypes of the
+    stored leaves win). Raises if the leaf count doesn't match."""
+    stored = load_checkpoint(path)["__leaves__"]
+    treedef = jax.tree_util.tree_structure(template)
+    if treedef.num_leaves != len(stored):
+        raise ValueError(
+            f"checkpoint has {len(stored)} leaves but the template "
+            f"structure expects {treedef.num_leaves}"
+        )
+    leaves = [stored[str(i)] for i in range(len(stored))]
+    return jax.tree_util.tree_unflatten(treedef, leaves)

@@ -59,20 +59,36 @@ def imagenet_preprocess(
 
 
 def _resize_center_crop(x: np.ndarray, size: int) -> np.ndarray:
-    """Resize the short side to `size`, then center-crop to size x size
-    (bilinear, via jax.image on host)."""
+    """Resize the short side to `size`, then center-crop to size x size.
+
+    Pure-numpy bilinear: host preprocessing must not touch the
+    accelerator the pipeline runs on, and a jit-based resize would
+    recompile for every distinct source (h, w) in a real image stream.
+    """
     n, h, w, c = x.shape
     scale = size / min(h, w)
     nh, nw = max(size, round(h * scale)), max(size, round(w * scale))
-    # Pin to the CPU backend: this is host-side work and must not
-    # compete with (or round-trip through) the accelerator the
-    # pipeline stages run on.
-    with jax.default_device(jax.local_devices(backend="cpu")[0]):
-        resized = np.asarray(
-            jax.image.resize(x, (n, nh, nw, c), method="bilinear")
-        )
+    resized = _bilinear_resize_np(x, nh, nw)
     top, left = (nh - size) // 2, (nw - size) // 2
     return resized[:, top : top + size, left : left + size, :]
+
+
+def _bilinear_resize_np(x: np.ndarray, nh: int, nw: int) -> np.ndarray:
+    """Vectorized half-pixel-centered bilinear resize, NHWC."""
+    n, h, w, c = x.shape
+    # Sample coordinates in source space (align half-pixel centers,
+    # matching jax.image.resize / TF2 'bilinear' semantics).
+    ys = np.clip((np.arange(nh) + 0.5) * h / nh - 0.5, 0, h - 1)
+    xs = np.clip((np.arange(nw) + 0.5) * w / nw - 0.5, 0, w - 1)
+    y0 = np.floor(ys).astype(np.int64)
+    x0 = np.floor(xs).astype(np.int64)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wy = (ys - y0).astype(x.dtype)[None, :, None, None]
+    wx = (xs - x0).astype(x.dtype)[None, None, :, None]
+    top = x[:, y0][:, :, x0] * (1 - wx) + x[:, y0][:, :, x1] * wx
+    bot = x[:, y1][:, :, x0] * (1 - wx) + x[:, y1][:, :, x1] * wx
+    return top * (1 - wy) + bot * wy
 
 
 def batched(

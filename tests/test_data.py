@@ -24,6 +24,21 @@ def test_preprocess_resizes_and_crops():
     assert out.shape == (2, 224, 224, 3)
 
 
+def test_numpy_resize_matches_jax_bilinear():
+    import jax
+
+    from defer_tpu.runtime.data import _bilinear_resize_np
+
+    x = np.random.default_rng(3).random((2, 37, 53, 3)).astype(np.float32)
+    got = _bilinear_resize_np(x, 24, 24)
+    # antialias=False: Keras preprocessing uses plain (non-antialiased)
+    # bilinear sampling, which is what the numpy path implements.
+    want = np.asarray(
+        jax.image.resize(x, (2, 24, 24, 3), "bilinear", antialias=False)
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
 def test_preprocess_caffe_mode_bgr():
     img = np.zeros((1, 224, 224, 3), np.float32)
     img[..., 0] = 255.0  # R

@@ -248,3 +248,49 @@ def test_bundle_member_not_carried_forward_rejected():
     g = b.build(b.add("dense", c, name="head", features=4))
     with pytest.raises(PartitionError, match="not carried across"):
         validate_cut_points(g, [("b",), ("c", "a")])
+
+
+def test_fuzz_random_dags_partition_composes():
+    """Randomized DAGs: every discovered articulation point (and some
+    random bundle boundaries) must validate and compose exactly."""
+    from defer_tpu.graph.partition import articulation_points
+
+    rng = np.random.default_rng(7)
+    for trial in range(12):
+        b = GraphBuilder(f"fuzz{trial}")
+        nodes = [b.input()]
+        for i in range(rng.integers(4, 14)):
+            k = int(rng.integers(1, min(3, len(nodes)) + 1))
+            srcs = list(
+                np.array(nodes)[rng.choice(len(nodes), k, replace=False)]
+            )
+            if k == 1:
+                n = b.add("dense", srcs[0], name=f"n{i}", features=6)
+            else:
+                # align feature dims: adds need equal shapes -> project
+                projected = [
+                    b.add("dense", s, name=f"n{i}p{j}", features=6)
+                    for j, s in enumerate(srcs)
+                ]
+                n = b.add("add", *projected, name=f"n{i}")
+            nodes.append(n)
+        g = b.build(b.add("dense", nodes[-1], name="out", features=2))
+
+        params = g.init(jax.random.key(trial), (2, 6))
+        x = jax.random.normal(jax.random.key(100 + trial), (2, 6))
+        full = g.apply(params, x)
+
+        pts = articulation_points(g)
+        for cut in pts:
+            stages = partition(g, [cut])
+            got = compose(stages, params, x)
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(full), rtol=1e-4,
+                err_msg=f"trial {trial} cut {cut}",
+            )
+        if len(pts) >= 2:
+            stages = partition(g, [pts[0], pts[-1]])
+            got = compose(stages, params, x)
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(full), rtol=1e-4
+            )
