@@ -64,7 +64,7 @@ class DEFER:
 
     def build_pipeline(
         self,
-        model: Model | Graph,
+        model: Model | Graph | str,
         partition_layers: Sequence[str | Sequence[str]] | str | None,
         *,
         params: GraphParams | None = None,
@@ -78,6 +78,12 @@ class DEFER:
         shipping becomes `device_put` of each stage's param slice.
         """
         cuts = normalize_cuts(partition_layers)
+        if isinstance(model, str):
+            # The reference's wire format: a Keras model.to_json()
+            # string (reference src/dispatcher.py:52).
+            from defer_tpu.graph.keras_import import model_from_keras
+
+            model, _ = model_from_keras(model)
         if isinstance(model, Model):
             graph = model.graph
             example = model.example_input(batch_size)
@@ -105,7 +111,7 @@ class DEFER:
 
     def run_defer(
         self,
-        model: Model | Graph,
+        model: Model | Graph | str,
         partition_layers: Sequence[str | Sequence[str]] | str | None,
         input_stream: "queue.Queue[Any]",
         output_stream: "queue.Queue[Any]",

@@ -114,6 +114,23 @@ def _depthwise(b: GraphBuilder, name: str, cfg, inputs):
     return _fused_activation(b, x, name, cfg)
 
 
+@_handler("SeparableConv2D")
+def _separable(b: GraphBuilder, name: str, cfg, inputs):
+    x = b.add(
+        "separable_conv",
+        inputs[0],
+        name=name,
+        features=int(cfg["filters"]),
+        kernel_size=tuple(cfg["kernel_size"]),
+        strides=tuple(cfg.get("strides", (1, 1))),
+        padding=_pad_attr(cfg),
+        dilation=tuple(cfg.get("dilation_rate", (1, 1))),
+        depth_multiplier=int(cfg.get("depth_multiplier", 1)),
+        use_bias=bool(cfg.get("use_bias", True)),
+    )
+    return _fused_activation(b, x, name, cfg)
+
+
 @_handler("Dense")
 def _dense(b: GraphBuilder, name: str, cfg, inputs):
     x = b.add(
@@ -294,6 +311,59 @@ def _inbound_names(inbound_nodes: Any) -> list[str]:
     return names
 
 
+def _sequential_to_functional(spec: Mapping[str, Any]) -> dict:
+    """Rewrite a Sequential model JSON as the functional layout: each
+    layer's inbound node is simply the previous layer."""
+    cfg = spec["config"]
+    layers = cfg["layers"] if isinstance(cfg, Mapping) else cfg
+    out_layers = []
+    prev: str | None = None
+    for layer in layers:
+        layer = dict(layer)
+        name = layer.get("name") or layer["config"].get("name")
+        if layer["class_name"] == "InputLayer":
+            prev = name
+            layer.setdefault("inbound_nodes", [])
+            out_layers.append(layer)
+            continue
+        if prev is None:
+            # Sequential without an explicit InputLayer: the first real
+            # layer carries batch_input_shape; synthesize the input.
+            shape = layer["config"].get("batch_input_shape")
+            if shape is None:
+                raise KerasImportError(
+                    "Sequential JSON lacks an InputLayer and the first "
+                    "layer has no batch_input_shape"
+                )
+            out_layers.append(
+                {
+                    "class_name": "InputLayer",
+                    "name": "seq_input",
+                    "config": {
+                        "name": "seq_input",
+                        "batch_input_shape": shape,
+                    },
+                    "inbound_nodes": [],
+                }
+            )
+            prev = "seq_input"
+        layer["inbound_nodes"] = [[[prev, 0, 0, {}]]]
+        out_layers.append(layer)
+        prev = name
+    if prev is None:
+        raise KerasImportError("Sequential model has no layers")
+    return {
+        "class_name": "Functional",
+        "config": {
+            "name": (cfg.get("name", "sequential") if isinstance(cfg, Mapping)
+                     else "sequential"),
+            "layers": out_layers,
+            "input_layers": [[out_layers[0]["name"], 0, 0]],
+            "output_layers": [[prev, 0, 0]],
+        },
+    }
+
+
 def from_keras_json(text: str | Mapping[str, Any]) -> tuple[Graph, tuple[int, ...]]:
     """Parse a Keras functional-model JSON into (Graph, input_shape).
 
@@ -302,9 +372,11 @@ def from_keras_json(text: str | Mapping[str, Any]) -> tuple[Graph, tuple[int, ..
     the reference would fail deep inside deserialization instead.
     """
     spec = json.loads(text) if isinstance(text, str) else text
+    if spec.get("class_name") == "Sequential":
+        spec = _sequential_to_functional(spec)
     if spec.get("class_name") not in ("Functional", "Model"):
         raise KerasImportError(
-            f"expected a functional model JSON, got class "
+            f"expected a functional or Sequential model JSON, got class "
             f"{spec.get('class_name')!r}"
         )
     cfg = spec["config"]

@@ -51,6 +51,7 @@ class TransplantError(ValueError):
 _KERAS_ORDER: dict[str, tuple[str, ...]] = {
     "conv": ("kernel", "bias"),
     "depthwise_conv": ("kernel", "bias"),
+    "separable_conv": ("dw_kernel", "pw_kernel", "bias"),
     "dense": ("kernel", "bias"),
     "batch_norm": ("scale", "bias", "mean", "var"),
 }
@@ -72,6 +73,9 @@ _TORCH_KEYS: dict[str, dict[str, str]] = {
 
 
 def _from_keras(op: str, param: str, value: np.ndarray) -> np.ndarray:
+    if op == "separable_conv" and param == "dw_kernel":
+        kh, kw = value.shape[:2]
+        return value.reshape(kh, kw, 1, -1)
     if op == "depthwise_conv" and param == "kernel":
         # (kh, kw, cin, mult) -> (kh, kw, 1, cin*mult). C-order flatten
         # puts output channel c*mult + m exactly where XLA's
@@ -82,6 +86,10 @@ def _from_keras(op: str, param: str, value: np.ndarray) -> np.ndarray:
 
 
 def _to_keras(op: str, param: str, value: np.ndarray, attrs) -> np.ndarray:
+    if op == "separable_conv" and param == "dw_kernel":
+        kh, kw, _, cm = value.shape
+        mult = int(attrs.get("depth_multiplier", 1))
+        return value.reshape(kh, kw, cm // mult, mult)
     if op == "depthwise_conv" and param == "kernel":
         kh, kw, _, cm = value.shape
         mult = int(attrs.get("depth_multiplier", 1))

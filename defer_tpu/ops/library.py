@@ -165,6 +165,55 @@ def batch_norm_apply(params, inputs, attrs):
     return x * scale + shift
 
 
+def _separable_init(rng, attrs, in_shapes, param_dtype):
+    kh, kw = _pair(attrs.get("kernel_size", 3))
+    cin = in_shapes[0][-1]
+    mult = int(attrs.get("depth_multiplier", 1))
+    cout = int(attrs["features"])
+    k1, k2 = jax.random.split(rng)
+    params = {
+        "dw_kernel": jax.random.normal(
+            k1, (kh, kw, 1, cin * mult), param_dtype
+        ) * jnp.sqrt(2.0 / (kh * kw)).astype(param_dtype),
+        "pw_kernel": jax.random.normal(
+            k2, (1, 1, cin * mult, cout), param_dtype
+        ) * jnp.sqrt(2.0 / (cin * mult)).astype(param_dtype),
+    }
+    if attrs.get("use_bias", True):
+        params["bias"] = jnp.zeros((cout,), param_dtype)
+    return params
+
+
+@register_op("separable_conv", init=_separable_init)
+def separable_conv_apply(params, inputs, attrs):
+    """Depthwise kxk then pointwise 1x1 as one op (Keras
+    SeparableConv2D), so checkpoints keyed by the Keras layer name map
+    onto a single node."""
+    (x,) = inputs
+    strides = _pair(attrs.get("strides", 1))
+    dilation = _pair(attrs.get("dilation", 1))
+    dw = params["dw_kernel"].astype(x.dtype)
+    out = lax.conv_general_dilated(
+        x,
+        dw,
+        window_strides=strides,
+        padding=_conv_padding(attrs.get("padding", "SAME"), dw.shape[:2], dilation),
+        rhs_dilation=dilation,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=x.shape[-1],
+    )
+    out = lax.conv_general_dilated(
+        out,
+        params["pw_kernel"].astype(x.dtype),
+        window_strides=(1, 1),
+        padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    if "bias" in params:
+        out = out + params["bias"].astype(x.dtype)
+    return out
+
+
 # --------------------------------------------------------------------------
 # pooling / padding / reshaping
 # --------------------------------------------------------------------------
