@@ -314,13 +314,30 @@ def _inbound_names(inbound_nodes: Any) -> list[str]:
 def _sequential_to_functional(spec: Mapping[str, Any]) -> dict:
     """Rewrite a Sequential model JSON as the functional layout: each
     layer's inbound node is simply the previous layer."""
-    cfg = spec["config"]
-    layers = cfg["layers"] if isinstance(cfg, Mapping) else cfg
+    cfg = spec.get("config")
+    layers = cfg.get("layers") if isinstance(cfg, Mapping) else cfg
+    if not isinstance(layers, (list, tuple)):
+        raise KerasImportError(
+            "Sequential JSON has no config.layers list; expected the "
+            "functional layout or a Sequential config with layers, got "
+            f"config={cfg!r}"
+        )
     out_layers = []
     prev: str | None = None
     for layer in layers:
+        if not isinstance(layer, Mapping) or "class_name" not in layer:
+            raise KerasImportError(
+                f"malformed Sequential layer entry (need a mapping with "
+                f"class_name/config): {layer!r}"
+            )
         layer = dict(layer)
-        name = layer.get("name") or layer["config"].get("name")
+        layer_cfg = layer.get("config")
+        if not isinstance(layer_cfg, Mapping):
+            raise KerasImportError(
+                f"Sequential layer {layer.get('name', layer['class_name'])!r} "
+                f"has no config mapping"
+            )
+        name = layer.get("name") or layer_cfg.get("name")
         if layer["class_name"] == "InputLayer":
             prev = name
             layer.setdefault("inbound_nodes", [])
@@ -329,7 +346,7 @@ def _sequential_to_functional(spec: Mapping[str, Any]) -> dict:
         if prev is None:
             # Sequential without an explicit InputLayer: the first real
             # layer carries batch_input_shape; synthesize the input.
-            shape = layer["config"].get("batch_input_shape")
+            shape = layer_cfg.get("batch_input_shape")
             if shape is None:
                 raise KerasImportError(
                     "Sequential JSON lacks an InputLayer and the first "

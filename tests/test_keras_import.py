@@ -180,9 +180,60 @@ def test_multi_output_rejected():
         from_keras_json(spec)
 
 
-def test_sequential_rejected_with_clear_error():
-    with pytest.raises(KerasImportError, match="functional"):
+def test_sequential_well_formed_converts_and_runs():
+    """A valid Sequential JSON (no explicit InputLayer — first layer
+    carries batch_input_shape) converts to a runnable graph."""
+    spec = {
+        "class_name": "Sequential",
+        "config": {
+            "name": "seq_mlp",
+            "layers": [
+                {
+                    "class_name": "Dense",
+                    "config": {
+                        "name": "d1",
+                        "units": 8,
+                        "activation": "relu",
+                        "batch_input_shape": [None, 4],
+                    },
+                },
+                {
+                    "class_name": "Dense",
+                    "config": {"name": "d2", "units": 3,
+                               "activation": "softmax"},
+                },
+            ],
+        },
+    }
+    graph, input_shape = from_keras_json(json.dumps(spec))
+    assert input_shape == (4,)
+    params = graph.init(jax.random.key(0), (2, 4))
+    out = graph.apply(params, jnp.ones((2, 4)))
+    assert out.shape == (2, 3)
+    np.testing.assert_allclose(np.asarray(out.sum(-1)), 1.0, rtol=1e-5)
+
+
+def test_sequential_malformed_config_rejected_with_clear_error():
+    """Sequential is supported, but a config without a layers list must
+    surface as KerasImportError, not a bare KeyError (reference would
+    crash deep inside keras deserialization instead)."""
+    with pytest.raises(KerasImportError, match="layers"):
         from_keras_json(json.dumps({"class_name": "Sequential", "config": {}}))
+    with pytest.raises(KerasImportError, match="layers"):
+        from_keras_json(json.dumps({"class_name": "Sequential"}))
+    with pytest.raises(KerasImportError, match="malformed"):
+        from_keras_json(
+            json.dumps({"class_name": "Sequential", "config": {"layers": [42]}})
+        )
+    with pytest.raises(KerasImportError, match="config"):
+        from_keras_json(
+            json.dumps(
+                {
+                    "class_name": "Sequential",
+                    "config": {"layers": [{"class_name": "Dense"}]},
+                }
+            )
+        )
 
 
 def test_h5_weights_path(tmp_path):
