@@ -8,12 +8,15 @@ that ecosystem: a user bringing a serialized Keras model (plus an h5
 weights file via `transplant.load_keras_h5`) gets an IR Graph that
 partitions/pipelines like any zoo model.
 
-Supports the classic functional-model JSON layout (`config.layers`
-with `inbound_nodes` as `[[[layer, node_idx, tensor_idx, kwargs]...]]`)
-that TF1-era Keras — the reference's environment (reference
-src/node.py:19-20) — emits, restricted to single-input single-output
-graphs (the same restriction the reference's partitioner has, reference
-src/dag_util.py:29-33).
+Supports both JSON dialects: the classic functional layout
+(`config.layers` with `inbound_nodes` as
+`[[[layer, node_idx, tensor_idx, kwargs]...]]`) that TF1-era Keras —
+the reference's environment (reference src/node.py:19-20) — emits, and
+the Keras 3 layout (`inbound_nodes` as `[{"args": ..., "kwargs": ...}]`
+with `__keras_tensor__`/`keras_history` entries, `batch_shape` inputs,
+flat single-io `input_layers`) that current `tf.keras` emits.
+Restricted to single-input single-output graphs (the same restriction
+the reference's partitioner has, reference src/dag_util.py:29-33).
 
 Layers with fused activations (e.g. Conv2D(activation='relu')) expand
 to two IR nodes; the activation node is named `<layer>_activation_fused`
@@ -288,27 +291,83 @@ def supported_layers() -> list[str]:
     return sorted(_HANDLERS)
 
 
+def _check_history(name: str, node_idx: int, tensor_idx: int) -> str:
+    if node_idx != 0 or tensor_idx != 0:
+        raise KerasImportError(
+            f"non-trivial inbound node ({name}, {node_idx}, "
+            f"{tensor_idx}) is not supported"
+        )
+    return name
+
+
+def _collect_keras3_tensors(obj: Any, names: list[str]) -> None:
+    """Depth-first collect `__keras_tensor__` histories from a Keras 3
+    node-args structure (tensors may be nested in lists, e.g. Add/
+    Concatenate take a list of tensors as one positional arg)."""
+    if isinstance(obj, Mapping):
+        if obj.get("class_name") == "__keras_tensor__":
+            hist = obj.get("config", {}).get("keras_history")
+            if not hist or len(hist) < 3:
+                raise KerasImportError(
+                    f"__keras_tensor__ lacks keras_history: {obj!r}"
+                )
+            names.append(_check_history(hist[0], hist[1], hist[2]))
+        else:
+            for v in obj.values():
+                _collect_keras3_tensors(v, names)
+    elif isinstance(obj, (list, tuple)):
+        for v in obj:
+            _collect_keras3_tensors(v, names)
+
+
 def _inbound_names(inbound_nodes: Any) -> list[str]:
-    """Extract producer layer names from classic inbound_nodes JSON:
-    [[[layer_name, node_index, tensor_index, kwargs], ...]] (one outer
-    entry — shared layers called multiple times are out of scope, as in
-    the reference)."""
+    """Extract producer layer names from inbound_nodes JSON.
+
+    Two dialects: classic TF1-era
+    `[[[layer_name, node_index, tensor_index, kwargs], ...]]` (the
+    reference's environment, reference src/node.py:19-20) and Keras 3
+    `[{"args": [...], "kwargs": {...}}]` where tensors appear as
+    `__keras_tensor__` dicts carrying `keras_history`. One inbound node
+    only — shared layers called multiple times are out of scope, as in
+    the reference."""
     if not inbound_nodes:
         return []
     if len(inbound_nodes) != 1:
         raise KerasImportError(
             "shared layers (multiple inbound nodes) are not supported"
         )
-    names = []
-    for entry in inbound_nodes[0]:
-        name, node_idx, tensor_idx = entry[0], entry[1], entry[2]
-        if node_idx != 0 or tensor_idx != 0:
+    node = inbound_nodes[0]
+    names: list[str] = []
+    if isinstance(node, Mapping):  # Keras 3 dialect
+        _collect_keras3_tensors(node.get("args", []), names)
+        if not names:
             raise KerasImportError(
-                f"non-trivial inbound node ({name}, {node_idx}, "
-                f"{tensor_idx}) is not supported"
+                f"Keras 3 inbound node has no tensor args: {node!r}"
             )
-        names.append(name)
+        return names
+    for entry in node:
+        names.append(_check_history(entry[0], entry[1], entry[2]))
     return names
+
+
+def _io_layer_name(specs: Any, which: str) -> str:
+    """Single input/output layer name from `input_layers` /
+    `output_layers`, accepting classic `[["name", 0, 0]]` and Keras 3
+    flat `["name", 0, 0]` forms."""
+    if not isinstance(specs, (list, tuple)) or not specs:
+        raise KerasImportError(f"model JSON lacks {which}")
+    if isinstance(specs[0], str):  # Keras 3 single-io flat form
+        entry = specs
+    elif len(specs) != 1:
+        raise KerasImportError(
+            "only single-input single-output models are supported (the "
+            "reference has the same restriction)"
+        )
+    else:
+        entry = specs[0]
+    if not isinstance(entry, (list, tuple)) or len(entry) < 3:
+        raise KerasImportError(f"malformed {which} entry: {entry!r}")
+    return _check_history(entry[0], entry[1], entry[2])
 
 
 def _sequential_to_functional(spec: Mapping[str, Any]) -> dict:
@@ -345,8 +404,14 @@ def _sequential_to_functional(spec: Mapping[str, Any]) -> dict:
             continue
         if prev is None:
             # Sequential without an explicit InputLayer: the first real
-            # layer carries batch_input_shape; synthesize the input.
-            shape = layer_cfg.get("batch_input_shape")
+            # layer carries batch_input_shape (classic) or the config
+            # has build_input_shape (Keras 3); synthesize the input.
+            shape = (
+                layer_cfg.get("batch_input_shape")
+                or layer_cfg.get("batch_shape")
+                or (cfg.get("build_input_shape")
+                    if isinstance(cfg, Mapping) else None)
+            )
             if shape is None:
                 raise KerasImportError(
                     "Sequential JSON lacks an InputLayer and the first "
@@ -399,16 +464,8 @@ def from_keras_json(text: str | Mapping[str, Any]) -> tuple[Graph, tuple[int, ..
     cfg = spec["config"]
     layers = cfg["layers"]
 
-    in_specs = cfg.get("input_layers")
-    out_specs = cfg.get("output_layers")
-    if in_specs is None or out_specs is None:
-        raise KerasImportError("model JSON lacks input_layers/output_layers")
-    if len(in_specs) != 1 or len(out_specs) != 1:
-        raise KerasImportError(
-            "only single-input single-output models are supported (the "
-            "reference has the same restriction)"
-        )
-    input_layer, output_layer = in_specs[0][0], out_specs[0][0]
+    input_layer = _io_layer_name(cfg.get("input_layers"), "input_layers")
+    output_layer = _io_layer_name(cfg.get("output_layers"), "output_layers")
 
     b = GraphBuilder(cfg.get("name", "keras_model"))
     produced: dict[str, str] = {}  # layer name -> IR node producing its output
@@ -502,6 +559,6 @@ def model_from_keras(
 
         base = model.init(rng if rng is not None else jax.random.key(0))
         loaded = transplant(
-            graph, base, KerasWeights(load_keras_h5(weights_h5))
+            graph, base, KerasWeights(load_keras_h5(weights_h5, text))
         )
     return model, loaded

@@ -38,6 +38,11 @@ class Model:
     # Each candidate is one boundary: a node name, or a tuple of names
     # for a multi-tensor bundle (NASNet's (cell_i, cell_i-1) pairs).
     cut_candidates: tuple[str | tuple[str, ...], ...] = ()
+    # IR node name -> layer name in the real tf.keras checkpoint, for
+    # transplanting actual Keras artifacts into the native graph (the
+    # reference consumes real checkpoints via set_weights, reference
+    # src/node.py:38-45). None = identity (names already match).
+    keras_name_map: Callable[[str], str] | None = None
 
     def init(
         self,

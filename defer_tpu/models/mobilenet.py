@@ -18,6 +18,29 @@ from defer_tpu.graph.ir import GraphBuilder
 from defer_tpu.models import Model, register_model
 
 
+def _keras_name(node: str) -> str:
+    """Native node name -> real tf.keras MobileNetV2 layer name (the
+    names `MobileNetV2(weights='imagenet')` checkpoints use): the stem
+    pair is `Conv1`/`bn_Conv1`, block convs drop the `_conv` suffix,
+    and block BNs use an upper-case `_BN` suffix."""
+    if node == "Conv1_conv":
+        return "Conv1"
+    if node == "Conv1_bn":
+        return "bn_Conv1"
+    if node == "Conv_1_conv":
+        return "Conv_1"
+    if node == "predictions_dense":
+        return "predictions"
+    for stem in ("_expand", "_project"):
+        if node.endswith(f"{stem}_conv"):
+            return node[: -len("_conv")]
+        if node.endswith(f"{stem}_bn"):
+            return node[: -len("_bn")] + "_BN"
+    if node.endswith("_depthwise_bn"):
+        return node[: -len("_bn")] + "_BN"
+    return node
+
+
 def _make_divisible(v: float, divisor: int = 8) -> int:
     """Channel rounding used by the MobileNet family (nearest multiple
     of 8, never dropping more than 10%)."""
@@ -133,4 +156,5 @@ def mobilenetv2(num_classes: int = 1000, alpha: float = 1.0) -> Model:
         graph=b.build(x),
         input_shape=(224, 224, 3),
         cut_candidates=tuple(cuts),
+        keras_name_map=_keras_name,
     )

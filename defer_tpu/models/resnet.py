@@ -11,8 +11,29 @@ each a valid single-tensor cut point (SURVEY.md §3.4).
 
 from __future__ import annotations
 
+import re
+
 from defer_tpu.graph.ir import GraphBuilder
 from defer_tpu.models import Model, register_model
+
+_BOTTLENECK_RE = re.compile(r"res(\d+)(.)_(a|b|c|proj)_(conv|bn)$")
+_PART_IDX = {"proj": 0, "a": 1, "b": 2, "c": 3}
+
+
+def _keras_name(node: str) -> str:
+    """Native node name -> real tf.keras ResNet layer name, e.g.
+    `res2a_a_conv` -> `conv2_block1_1_conv`, `res3b_proj_bn` ->
+    `conv3_block2_0_bn`, `fc` -> `predictions` (the names
+    `ResNet50(weights='imagenet')` checkpoints use, reference
+    src/local_infer.py:8)."""
+    if node == "fc":
+        return "predictions"
+    m = _BOTTLENECK_RE.match(node)
+    if m:
+        group, letter, part, kind = m.groups()
+        block = ord(letter) - ord("a") + 1
+        return f"conv{group}_block{block}_{_PART_IDX[part]}_{kind}"
+    return node
 
 
 def _conv_bn_relu(
@@ -125,6 +146,7 @@ def _build_resnet(
         graph=graph,
         input_shape=(224, 224, 3),
         cut_candidates=tuple(adds),
+        keras_name_map=_keras_name,
     )
 
 

@@ -321,17 +321,78 @@ def export_keras_weights(
     return out
 
 
-def load_keras_h5(path: str) -> dict[str, list[np.ndarray]]:
+def _to_snake_case(name: str) -> str:
+    """Keras's class-name -> object-name rule (Conv2D -> conv2d,
+    BatchNormalization -> batch_normalization, ReLU -> re_lu)."""
+    import re
+
+    name = re.sub(r"\W+", "", name)
+    name = re.sub("(.)([A-Z][a-z]+)", r"\1_\2", name)
+    return re.sub("([a-z])([A-Z])", r"\1_\2", name).lower()
+
+
+def _keras3_group_names(model_json) -> dict[str, str]:
+    """h5 group name -> real layer name for a Keras 3 `.weights.h5`.
+
+    Keras 3 names each layer's h5 group by snake-cased class name with
+    a per-class counter in model.layers order (NOT by `layer.name`);
+    the model JSON's config.layers order reproduces that assignment.
+    """
+    import json as _json
+
+    spec = (
+        _json.loads(model_json) if isinstance(model_json, str) else model_json
+    )
+    layers = spec.get("config", {}).get("layers", [])
+    counters: dict[str, int] = {}
+    mapping: dict[str, str] = {}
+    for layer in layers:
+        cls = layer.get("class_name", "")
+        name = layer.get("name") or layer.get("config", {}).get("name")
+        base = _to_snake_case(cls)
+        idx = counters.get(base, 0)
+        counters[base] = idx + 1
+        mapping[base if idx == 0 else f"{base}_{idx}"] = name
+    return mapping
+
+
+def load_keras_h5(
+    path: str, model_json=None
+) -> dict[str, list[np.ndarray]]:
     """Read a Keras `save_weights` HDF5 file into `{layer: [arrays]}`.
 
-    Supports the classic topological layout (`layer_names` /
-    `weight_names` attrs), which is what `tf.keras` writes for the
-    reference's zoo models.
+    Supports both on-disk layouts: the classic topological layout
+    (`layer_names` / `weight_names` attrs) that TF1/2-era Keras — the
+    reference's environment — writes, and the Keras 3 `.weights.h5`
+    layout (`layers/<object_name>/vars/<i>` datasets in
+    `layer.weights` order, which matches `get_weights()` ordering).
+    Keras 3 group names are per-class counters, not layer names; pass
+    the model's `to_json()` string as `model_json` to resolve them to
+    real layer names (otherwise the raw object names are returned).
     """
     import h5py
 
     out: dict[str, list[np.ndarray]] = {}
     with h5py.File(path, "r") as f:
+        if "layers" in f and "layer_names" not in f.attrs:
+            # Keras 3 layout.
+            resolve = (
+                _keras3_group_names(model_json) if model_json is not None
+                else {}
+            )
+            layers_group = f["layers"]
+            for lname in layers_group:
+                g = layers_group[lname]
+                if "vars" not in g:
+                    continue
+                vars_group = g["vars"]
+                arrays = [
+                    np.asarray(vars_group[k])
+                    for k in sorted(vars_group, key=int)
+                ]
+                if arrays:
+                    out[resolve.get(lname, lname)] = arrays
+            return out
         root = f["model_weights"] if "model_weights" in f else f
         layer_names = [
             n.decode() if isinstance(n, bytes) else n
