@@ -27,6 +27,17 @@ from defer_tpu.utils.logging import get_logger
 log = get_logger(__name__)
 
 
+def _already_initialized() -> bool:
+    """Whether jax.distributed.initialize already ran in this process,
+    without touching (and thereby initializing) the XLA backend."""
+    try:
+        from jax._src import distributed as _dist
+
+        return _dist.global_state.client is not None
+    except Exception:  # noqa: BLE001 — private-API drift fallback
+        return False
+
+
 def initialize(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
@@ -48,14 +59,17 @@ def initialize(
         v in os.environ
         for v in ("COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS")
     )
-    if explicit or discovered or jax.process_count() > 1:
-        if jax.process_count() == 1 or explicit:
-            jax.distributed.initialize(
-                coordinator_address=coordinator_address,
-                num_processes=num_processes,
-                process_id=process_id,
-            )
-    else:
+    # jax.distributed.initialize must run before ANY backend-touching
+    # call — including jax.process_count() — so "already initialized"
+    # is read from the distributed runtime's own state, not the
+    # backend.
+    if (explicit or discovered) and not _already_initialized():
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    elif not (explicit or discovered):
         log.info("single-process run; jax.distributed not initialized")
     topo = {
         "process_index": jax.process_index(),

@@ -59,6 +59,9 @@ def make_spmd_pipeline(
 
     Returns:
       run(stacked_params, xs): xs [M, B, ...] -> ys [M, B, ...], jittable.
+      The global output buffer is exactly [M, B, ...]: non-final stages'
+      per-step emissions are masked and psum-reduced away inside the
+      shard_map rather than materialized as [S, M+S-1, B, ...].
     """
     num_stages = mesh.shape[stage_axis]
     shift = [(i, (i + 1) % num_stages) for i in range(num_stages)]
@@ -90,23 +93,23 @@ def make_spmd_pipeline(
             return lax.ppermute(out, stage_axis, shift), out
 
         _, emits = lax.scan(step, buf, jnp.arange(steps))
-        # Every device returns its per-step outputs; only the last
-        # stage's tail is meaningful and the wrapper slices exactly that
-        # shard — no output collective needed.
-        return emits[None]
+        # Only the final stage's steady-state tail is meaningful: mask
+        # the other stages' emissions and reduce over the stage axis so
+        # the global output buffer is [M, B, ...] — not the S x
+        # (M+S-1) materialization of every stage's per-step outputs.
+        tail = lax.dynamic_slice_in_dim(
+            emits, num_stages - 1, num_mb, axis=0
+        )
+        is_last = stage_id == num_stages - 1
+        tail = jnp.where(is_last, tail, jnp.zeros_like(tail))
+        return lax.psum(tail, stage_axis)
 
     act_axes = (data_axis,) if seq_axis is None else (data_axis, seq_axis)
     in_specs = (param_specs, P(None, *act_axes))
-    out_specs = P(stage_axis, None, *act_axes)
-    mapped = jax.shard_map(
+    out_specs = P(None, *act_axes)
+    return jax.shard_map(
         pipelined, mesh=mesh, in_specs=in_specs, out_specs=out_specs
     )
-
-    def run(stacked_params, xs):
-        emits = mapped(stacked_params, xs)  # [S, M+S-1, B, ...]
-        return emits[-1, num_stages - 1 :]
-
-    return run
 
 
 def stack_for_stages(params: Any, num_stages: int) -> Any:

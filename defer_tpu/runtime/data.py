@@ -91,6 +91,29 @@ def _bilinear_resize_np(x: np.ndarray, nh: int, nw: int) -> np.ndarray:
     return top * (1 - wy) + bot * wy
 
 
+def load_image_dir(
+    path: str,
+    *,
+    extensions: Sequence[str] = (".png", ".jpg", ".jpeg", ".bmp"),
+) -> Iterator[np.ndarray]:
+    """Decode every image in a directory (sorted order) to uint8 HWC
+    RGB numpy arrays — the reference's PIL input path (reference
+    src/test.py:13-16) as a stream instead of one hard-coded file."""
+    import os
+
+    from PIL import Image
+
+    names = sorted(
+        f for f in os.listdir(path)
+        if os.path.splitext(f)[1].lower() in extensions
+    )
+    if not names:
+        raise FileNotFoundError(f"no images with {extensions} under {path!r}")
+    for name in names:
+        with Image.open(os.path.join(path, name)) as im:
+            yield np.asarray(im.convert("RGB"))
+
+
 def batched(
     examples: Iterable[np.ndarray],
     batch_size: int,

@@ -9,6 +9,12 @@ unchanged, so a reference user's driver ports line for line.
 
     python examples/distributed_infer.py --model resnet50 --minutes 1
     python examples/distributed_infer.py --cuts add_2,add_4,add_6,add_8
+    python examples/distributed_infer.py --images examples/images
+
+Inputs are real decoded images (PIL -> preprocess -> batch -> device
+prefetch), cycled for the duration of the run — the reference's
+image-feed loop (reference src/test.py:13-16,52-54) with a directory
+instead of one hard-coded JPEG. --synthetic feeds jnp.ones instead.
 """
 
 from __future__ import annotations
@@ -26,6 +32,7 @@ if os.environ.get("JAX_PLATFORMS"):
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 import argparse
+import itertools
 import queue
 import threading
 import time
@@ -34,6 +41,29 @@ import jax.numpy as jnp
 
 from defer_tpu.api import DEFER
 from defer_tpu.models import get_model
+from defer_tpu.runtime.data import (
+    batched,
+    imagenet_preprocess,
+    load_image_dir,
+    prefetch_to_device,
+)
+
+# Keras-weights input conventions per zoo family.
+_CAFFE_MODELS = ("resnet50", "resnet101", "resnet152", "vgg16", "vgg19")
+
+
+def image_stream(images_dir: str, model, batch: int):
+    """Decode -> preprocess -> batch -> device-prefetch, cycling the
+    directory forever (static shapes; prefetch overlaps host decode +
+    transfer with device compute)."""
+    mode = "caffe" if model.name in _CAFFE_MODELS else "scale"
+    size = model.input_shape[0]
+
+    def examples():
+        for im in itertools.cycle(load_image_dir(images_dir)):
+            yield imagenet_preprocess(im, size=size, mode=mode)[0]
+
+    return prefetch_to_device(batched(examples(), batch))
 
 
 def main() -> None:
@@ -47,6 +77,16 @@ def main() -> None:
     )
     ap.add_argument("--minutes", type=float, default=5.0)
     ap.add_argument("--batch", type=int, default=1)
+    ap.add_argument(
+        "--images",
+        default=os.path.join(os.path.dirname(__file__), "images"),
+        help="directory of images to cycle through the pipeline",
+    )
+    ap.add_argument(
+        "--synthetic",
+        action="store_true",
+        help="feed jnp.ones instead of decoding real images",
+    )
     args = ap.parse_args()
 
     model = get_model(args.model)
@@ -62,7 +102,11 @@ def main() -> None:
     # The reference sizes these 10 deep for backpressure (test.py:44-45).
     input_q: queue.Queue = queue.Queue(10)
     output_q: queue.Queue = queue.Queue()
-    x = model.example_input(args.batch)
+    if args.synthetic:
+        x = model.example_input(args.batch)
+        feed = itertools.repeat(x)
+    else:
+        feed = image_stream(args.images, model, args.batch)
 
     run_s = args.minutes * 60
     start = time.time()
@@ -89,15 +133,21 @@ def main() -> None:
     a.start()
     b.start()
 
-    while (time.time() - start) < run_s:
-        input_q.put(x)  # blocks at depth 10 — backpressure, as in test.py:52
-    input_q.put(None)
-    # Join the pipeline thread before exiting: tearing the interpreter
-    # down mid-compile crashes XLA, and run_defer drains in-flight
-    # results on the way out.
-    a.join()
-    output_q.put(None)
-    b.join()
+    try:
+        while (time.time() - start) < run_s:
+            # blocks at depth 10 — backpressure, as in test.py:52
+            input_q.put(next(feed))
+    finally:
+        # Always flow the sentinels, even when the image feed raises —
+        # otherwise the result thread blocks on output_q forever and
+        # the process never exits.
+        input_q.put(None)
+        # Join the pipeline thread before exiting: tearing the
+        # interpreter down mid-compile crashes XLA, and run_defer
+        # drains in-flight results on the way out.
+        a.join()
+        output_q.put(None)
+        b.join()
 
 
 if __name__ == "__main__":

@@ -39,6 +39,25 @@ def test_pipeline_equals_sequential(devices):
     np.testing.assert_allclose(np.asarray(ys), np.asarray(want), rtol=1e-6)
 
 
+def test_pipeline_output_buffer_is_microbatch_sized(devices):
+    """The pipeline's global output buffer must be [M, B, ...] — not the
+    [S, M+S-1, B, ...] per-stage materialization (every stage's per-step
+    emissions are masked and reduced away inside the shard_map)."""
+    S, M, B, D = 4, 6, 2, 8
+    mesh = make_mesh({"stage": S}, devices[:S])
+    params = {"w": jnp.ones((S, D))}
+    specs = {"w": P("stage")}
+
+    def stage_fn(p, x):
+        return x * p["w"]
+
+    run = make_spmd_pipeline(mesh, stage_fn, specs, stage_axis="stage")
+    out = jax.eval_shape(run, params, jnp.zeros((M, B, D)))
+    # `run` IS the shard_map-ed function now — its output spec is the
+    # global buffer; no host-side slicing of a larger array happens.
+    assert out.shape == (M, B, D)
+
+
 def _bert_check(mesh, devices, batch=4, num_mb=5):
     cfg = TransformerConfig(
         num_layers=4, dim=32, num_heads=4, ffn_dim=64, vocab_size=64,
