@@ -272,9 +272,57 @@ def _dropout(b: GraphBuilder, name: str, cfg, inputs):
     return b.add("dropout", inputs[0], name=name)
 
 
+@_handler("Rescaling")
+def _rescaling(b: GraphBuilder, name: str, cfg, inputs):
+    return b.add(
+        "rescale",
+        inputs[0],
+        name=name,
+        scale=float(cfg.get("scale", 1.0)),
+        offset=float(cfg.get("offset", 0.0)),
+    )
+
+
+@_handler("Normalization")
+def _normalization(b: GraphBuilder, name: str, cfg, inputs):
+    axis = cfg.get("axis", -1)
+    if isinstance(axis, (list, tuple)):
+        axis = axis[0] if len(axis) == 1 else axis
+    if axis not in (-1, 3):
+        raise KerasImportError(
+            f"Normalization {name!r}: only channels-last (axis=-1/3) is "
+            f"supported, got axis={axis}"
+        )
+    if cfg.get("invert"):
+        raise KerasImportError(
+            f"Normalization {name!r}: invert=True is not supported"
+        )
+    attrs = {}
+    if cfg.get("mean") is not None:
+        attrs = {"mean": cfg["mean"], "variance": cfg["variance"]}
+    return b.add("normalization", inputs[0], name=name, **attrs)
+
+
 @_handler("Add")
 def _add(b: GraphBuilder, name: str, cfg, inputs):
     return b.add("add", *inputs, name=name)
+
+
+@_handler("CustomScaleLayer")
+def _custom_scale(b: GraphBuilder, name: str, cfg, inputs):
+    """Keras applications' InceptionResNetV2 residual scaling:
+    inputs[0] + inputs[1] * scale."""
+    if len(inputs) != 2:
+        raise KerasImportError(
+            f"CustomScaleLayer {name!r} expects 2 inputs, got {len(inputs)}"
+        )
+    scaled = b.add(
+        "scale",
+        inputs[1],
+        name=f"{name}_scaled",
+        value=float(cfg.get("scale", 1.0)),
+    )
+    return b.add("add", inputs[0], scaled, name=name)
 
 
 @_handler("Multiply")

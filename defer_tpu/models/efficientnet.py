@@ -160,7 +160,19 @@ def _build_efficientnet(
         graph=b.build(x),
         input_shape=(resolution, resolution, 3),
         cut_candidates=tuple(cuts),
+        keras_name_map=_keras_name,
     )
+
+
+def _keras_name(node: str) -> str:
+    """Native node name -> real tf.keras EfficientNet layer name: the
+    depthwise BN is plain `{block}_bn` in Keras, and the softmax head
+    is the fused `predictions` Dense."""
+    if node == "predictions_dense":
+        return "predictions"
+    if node.endswith("_dwconv_bn"):
+        return node[: -len("_dwconv_bn")] + "_bn"
+    return node
 
 
 @register_model("efficientnet_b0")

@@ -54,6 +54,9 @@ _KERAS_ORDER: dict[str, tuple[str, ...]] = {
     "separable_conv": ("dw_kernel", "pw_kernel", "bias"),
     "dense": ("kernel", "bias"),
     "batch_norm": ("scale", "bias", "mean", "var"),
+    # Keras Normalization stores [adapt_mean, adapt_variance, count];
+    # count is bookkeeping with no analogue here and is never requested.
+    "normalization": ("mean", "var", "count"),
 }
 
 _TORCH_KEYS: dict[str, dict[str, str]] = {

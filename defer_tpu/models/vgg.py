@@ -44,6 +44,11 @@ def _build_vgg(
         graph=b.build(x),
         input_shape=(224, 224, 3),
         cut_candidates=tuple(cuts),
+        # Node names already match real tf.keras VGG checkpoints
+        # (block{b}_conv{i}, fc1, fc2) except the split softmax head.
+        keras_name_map=lambda n: (
+            "predictions" if n == "predictions_dense" else n
+        ),
     )
 
 

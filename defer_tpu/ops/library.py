@@ -383,3 +383,38 @@ def softmax_apply(params, inputs, attrs):
 def scale_apply(params, inputs, attrs):
     """x * constant (InceptionResNetV2 residual scaling)."""
     return inputs[0] * float(attrs["value"])
+
+
+@register_op("rescale")
+def rescale_apply(params, inputs, attrs):
+    """x * scale + offset (Keras Rescaling, e.g. EfficientNet's
+    in-model 1/255)."""
+    return inputs[0] * float(attrs.get("scale", 1.0)) + float(
+        attrs.get("offset", 0.0)
+    )
+
+
+def _normalization_init(rng, attrs, in_shapes, param_dtype):
+    del rng
+    if attrs.get("mean") is not None:
+        return {}  # statistics baked into attrs, nothing to learn/load
+    c = in_shapes[0][-1]
+    return {
+        "mean": jnp.zeros((c,), param_dtype),
+        "var": jnp.ones((c,), param_dtype),
+    }
+
+
+@register_op("normalization", init=_normalization_init)
+def normalization_apply(params, inputs, attrs):
+    """Keras Normalization (adapted feature scaling):
+    (x - mean) / max(sqrt(var), eps), eps = Keras backend epsilon."""
+    (x,) = inputs
+    if "mean" in params:
+        mean = params["mean"].astype(jnp.float32)
+        var = params["var"].astype(jnp.float32)
+    else:
+        mean = jnp.asarray(attrs["mean"], jnp.float32)
+        var = jnp.asarray(attrs["variance"], jnp.float32)
+    denom = jnp.maximum(jnp.sqrt(var), 1e-7)
+    return ((x.astype(jnp.float32) - mean) / denom).astype(x.dtype)

@@ -38,9 +38,11 @@ def imagenet_preprocess(
 ) -> np.ndarray:
     """uint8/float HWC (or NHWC) images -> float32 NHWC model input.
 
-    mode="scale": x/127.5 - 1 (the MobileNet/Inception/EfficientNet
-    family convention). mode="caffe": BGR mean subtraction (ResNet50/
-    VGG Keras weights convention).
+    mode="scale": x/127.5 - 1 (the MobileNet/Inception family
+    convention). mode="caffe": BGR mean subtraction (ResNet50/VGG
+    Keras weights convention). mode="unit": x/255 (EfficientNet — the
+    real Keras model's Rescaling head, whose un-adapted Normalization
+    is identity; the native zoo graph expects this done on the host).
     """
     x = np.asarray(images)
     if x.ndim == 3:
@@ -52,6 +54,8 @@ def imagenet_preprocess(
         x = _resize_center_crop(x, size)
     if mode == "scale":
         return x / 127.5 - 1.0
+    if mode == "unit":
+        return x / 255.0
     if mode == "caffe":
         # RGB -> BGR, subtract ImageNet channel means.
         return x[..., ::-1] - np.array([103.939, 116.779, 123.68], np.float32)

@@ -52,11 +52,19 @@ from defer_tpu.runtime.data import (
 _CAFFE_MODELS = ("resnet50", "resnet101", "resnet152", "vgg16", "vgg19")
 
 
+def _preprocess_mode(model_name: str) -> str:
+    if model_name in _CAFFE_MODELS:
+        return "caffe"
+    if model_name.startswith("efficientnet"):
+        return "unit"  # Rescaling(1/255) lives in the real Keras model
+    return "scale"
+
+
 def image_stream(images_dir: str, model, batch: int):
     """Decode -> preprocess -> batch -> device-prefetch, cycling the
     directory forever (static shapes; prefetch overlaps host decode +
     transfer with device compute)."""
-    mode = "caffe" if model.name in _CAFFE_MODELS else "scale"
+    mode = _preprocess_mode(model.name)
     size = model.input_shape[0]
 
     def examples():

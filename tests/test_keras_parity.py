@@ -39,6 +39,14 @@ _BUILDERS = {
     "resnet50": lambda: tf.keras.applications.ResNet50(weights=None),
     "mobilenetv2": lambda: tf.keras.applications.MobileNetV2(weights=None),
     "inceptionv3": lambda: tf.keras.applications.InceptionV3(weights=None),
+    "vgg16": lambda: tf.keras.applications.VGG16(weights=None),
+    "efficientnet_b0": lambda: tf.keras.applications.EfficientNetB0(
+        weights=None
+    ),
+    "inception_resnet_v2": lambda: tf.keras.applications.InceptionResNetV2(
+        weights=None
+    ),
+    "nasnet_mobile": lambda: tf.keras.applications.NASNetMobile(weights=None),
 }
 
 
@@ -74,7 +82,18 @@ def _assert_close(y_jax, y_tf, name):
     )
 
 
-@pytest.mark.parametrize("name", ["resnet50", "mobilenetv2", "inceptionv3"])
+@pytest.mark.parametrize(
+    "name",
+    [
+        "resnet50",
+        "mobilenetv2",
+        "inceptionv3",
+        "vgg16",
+        "efficientnet_b0",
+        "inception_resnet_v2",
+        "nasnet_mobile",
+    ],
+)
 def test_json_plus_h5_reproduces_tf_forward(name, keras_artifacts):
     json_str, weights_path, y_tf, x = keras_artifacts(name)
     model, params = model_from_keras(json_str, weights_h5=weights_path)
@@ -83,7 +102,9 @@ def test_json_plus_h5_reproduces_tf_forward(name, keras_artifacts):
     _assert_close(y, y_tf, name)
 
 
-@pytest.mark.parametrize("name", ["resnet50", "mobilenetv2"])
+@pytest.mark.parametrize(
+    "name", ["resnet50", "mobilenetv2", "vgg16", "efficientnet_b0"]
+)
 def test_native_zoo_consumes_real_checkpoint(name, keras_artifacts):
     json_str, weights_path, y_tf, x = keras_artifacts(name)
     model = get_model(name)
@@ -98,5 +119,10 @@ def test_native_zoo_consumes_real_checkpoint(name, keras_artifacts):
         ),
         strict=True,
     )
+    if name == "efficientnet_b0":
+        # The native graph takes already-preprocessed input; the real
+        # Keras model embeds Rescaling(1/255) + Normalization (identity
+        # for an un-adapted model) at its head.
+        x = x / 255.0
     y = model.graph.apply(params, x)
     _assert_close(y, y_tf, name)
