@@ -122,7 +122,10 @@ def cpu_baseline_subprocess(duration_s: float = 6.0) -> float:
 def _measure(pipe, batch: int, target_s: float = 4.0) -> dict:
     import jax.numpy as jnp
 
-    x = jnp.ones((batch, 224, 224, 3), jnp.float32)
+    # Feed bf16 end-to-end: the host pipeline emits bf16
+    # (imagenet_preprocess out_dtype), so no per-microbatch fp32->bf16
+    # cast pass over HBM.
+    x = jnp.ones((batch, 224, 224, 3), jnp.bfloat16)
     probe = pipe.throughput(x, num_microbatches=32)
     num_mb = max(32, int(32 * target_s / max(probe["seconds"], 1e-6)))
     return (
@@ -218,7 +221,7 @@ def run_bench() -> dict:
         stages,
         params,
         pipeline_devices(n_stages),
-        DeferConfig(compute_dtype=jnp.bfloat16),
+        DeferConfig(compute_dtype=jnp.bfloat16, max_inflight=128),
     )
     log(f"pipeline: {n_stages} stage(s) over {n_dev} device(s), cuts={cuts}")
 
@@ -296,7 +299,7 @@ def run_bench() -> dict:
                 partition(model.graph, ms_cuts),
                 params,
                 pipeline_devices(ms_stages),
-                DeferConfig(compute_dtype=jnp.bfloat16),
+                DeferConfig(compute_dtype=jnp.bfloat16, max_inflight=128),
             )
             stats = _measure(ms_pipe, best_batch)
             multi = {

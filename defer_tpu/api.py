@@ -96,7 +96,9 @@ class DEFER:
             params = model.init(
                 rng if rng is not None else jax.random.key(0),
                 batch_size=batch_size,
-                param_dtype=self.config.param_dtype,
+                # Init in fp32 (stable RNG/statistics); Pipeline casts
+                # to the storage dtype at placement.
+                param_dtype=jnp.float32,
             )
         stages = partition(graph, cuts) if cuts else [graph]
         devices = pipeline_devices(len(stages), self.devices)

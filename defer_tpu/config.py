@@ -22,7 +22,11 @@ class DeferConfig:
     Attributes:
       compute_dtype: dtype activations/params are cast to for compute.
         bfloat16 keeps matmuls/convs on the MXU at full rate.
-      param_dtype: dtype parameters are stored in.
+      param_dtype: dtype parameters are STORED in on device. None (the
+        default) stores them in compute_dtype — for bf16 inference that
+        removes a full fp32->bf16 cast pass over the weights on every
+        microbatch (~10% ResNet50 throughput on v5e). Set an explicit
+        dtype (e.g. jnp.float32) to keep higher-precision storage.
       max_inflight: microbatches allowed in flight before the host blocks
         on the oldest result — the backpressure analogue of the
         reference's bounded queues (reference src/test.py:44,
@@ -37,7 +41,14 @@ class DeferConfig:
     """
 
     compute_dtype: Any = jnp.bfloat16
-    param_dtype: Any = jnp.float32
+    param_dtype: Any = None
+
+    @property
+    def storage_dtype(self) -> Any:
+        """The dtype parameters are actually stored in on device."""
+        return self.param_dtype if self.param_dtype is not None else (
+            self.compute_dtype
+        )
     max_inflight: int = 32
     probe_every: int = 0
     donate_activations: bool = True
