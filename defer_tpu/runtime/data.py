@@ -53,6 +53,14 @@ def imagenet_preprocess(
         x = x[None]
     if x.ndim != 4:
         raise ValueError(f"expected HWC or NHWC images, got shape {x.shape}")
+    if x.dtype == np.uint8:
+        # Fast path: the fused native C++ pass (resize+crop+affine in
+        # one multithreaded sweep, defer_tpu/native/imageproc.cpp).
+        from defer_tpu.runtime.native_image import native_preprocess
+
+        out = native_preprocess(x, size=size, mode=mode, out_dtype=out_dtype)
+        if out is not None:
+            return out
     x = x.astype(np.float32)
     if x.shape[1] != size or x.shape[2] != size:
         x = _resize_center_crop(x, size)

@@ -163,3 +163,56 @@ def test_decode_preprocess_infer_end_to_end(tmp_path, devices):
     np.testing.assert_allclose(
         outs[0], np.asarray(g.apply(params, xb)), rtol=1e-5, atol=1e-6
     )
+
+
+def test_native_preprocess_matches_numpy():
+    """The fused C++ preprocessor must match the numpy path on every
+    mode, dtype, and geometry (resize-down, resize-up, identity)."""
+    import ml_dtypes
+
+    from defer_tpu.runtime.native_image import (
+        native_available,
+        native_preprocess,
+    )
+
+    if not native_available():
+        pytest.skip("no native toolchain; numpy fallback covers this host")
+    rng = np.random.RandomState(3)
+    # (1, 89, 64, 3) pins the half-to-even rounding case: 89*0.5 = 44.5
+    # must round to 44 (numpy round()), not 45 (llround).
+    for shape in [(2, 50, 70, 3), (1, 96, 40, 3), (1, 32, 32, 3),
+                  (1, 89, 64, 3)]:
+        imgs = rng.randint(0, 256, shape).astype(np.uint8)
+        for mode in ("scale", "unit", "caffe"):
+            got = native_preprocess(imgs, size=32, mode=mode)
+            assert got is not None and got.dtype == np.float32
+            # Reference numpy path (bypass the native fast path by
+            # feeding float input).
+            want = imagenet_preprocess(
+                imgs.astype(np.float32), size=32, mode=mode
+            )
+            np.testing.assert_allclose(got, want, atol=2e-3)
+            # bf16 output: same values rounded to bfloat16.
+            got16 = native_preprocess(
+                imgs, size=32, mode=mode, out_dtype=ml_dtypes.bfloat16
+            )
+            assert got16.dtype == np.dtype(ml_dtypes.bfloat16)
+            np.testing.assert_allclose(
+                got16.astype(np.float32),
+                want.astype(ml_dtypes.bfloat16).astype(np.float32),
+                atol=2.0 if mode == "caffe" else 2e-2,
+            )
+
+
+def test_uint8_preprocess_uses_native_and_matches():
+    """imagenet_preprocess(uint8) routes through the native path and
+    agrees with the float path."""
+    from defer_tpu.runtime.native_image import native_available
+
+    if not native_available():
+        pytest.skip("no native toolchain; numpy fallback covers this host")
+    rng = np.random.RandomState(4)
+    imgs = rng.randint(0, 256, (2, 41, 63, 3)).astype(np.uint8)
+    got = imagenet_preprocess(imgs, size=24, mode="caffe")
+    want = imagenet_preprocess(imgs.astype(np.float32), size=24, mode="caffe")
+    np.testing.assert_allclose(got, want, atol=2e-3)
