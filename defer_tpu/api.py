@@ -107,7 +107,66 @@ class DEFER:
         )
         pipe = Pipeline(stages, params, devices, self.config)
         self.last_pipeline = pipe
+        # Retained for elastic re-dispatch after a stage failure.
+        self._build_state = (stages, params)
         return pipe, example
+
+    # -- elastic recovery -------------------------------------------------
+
+    def _healthy_devices(self, timeout_s: float = 10.0) -> list[jax.Device]:
+        """Probe every candidate device with a tiny computation; a
+        device that errors or misses the deadline is excluded from
+        re-dispatch. Probes run concurrently under ONE shared deadline
+        (hard_sync_timeout fetches in helper threads and dedupes by
+        array), so n hung devices cost max(timeout), not n*timeout."""
+        devs = self.devices if self.devices is not None else jax.devices()
+        probes: list[tuple[jax.Device, Any]] = []
+        healthy: list[jax.Device] = []
+        for d in devs:
+            try:
+                probes.append(
+                    (d, jax.device_put(jnp.zeros((), jnp.float32), d) + 1.0)
+                )
+            except Exception as e:  # noqa: BLE001 — exclusion is the point
+                log.warning("device %s failed the health probe: %s", d, e)
+        for _, probe in probes:  # start every fetch thread
+            try:
+                hard_sync_timeout(probe, 0.0)
+            except Exception:  # noqa: BLE001 — surfaced in the wait below
+                pass
+        deadline = time.monotonic() + timeout_s
+        for d, probe in probes:
+            try:
+                if hard_sync_timeout(
+                    probe, max(0.0, deadline - time.monotonic())
+                ):
+                    healthy.append(d)
+                else:
+                    log.warning("device %s missed the health deadline", d)
+            except Exception as e:  # noqa: BLE001 — exclusion is the point
+                log.warning("device %s failed the health probe: %s", d, e)
+        return healthy
+
+    def _redispatch(self, cause: BaseException) -> Pipeline:
+        """Rebuild the pipeline on the devices that still pass a health
+        probe — the recovery the reference lacks entirely (node death
+        hangs it forever, reference src/node.py:102-103)."""
+        healthy = self._healthy_devices()
+        if not healthy:
+            raise RuntimeError(
+                "re-dispatch impossible: no device passed the health probe"
+            ) from cause
+        stages, params = self._build_state
+        devices = pipeline_devices(len(stages), healthy)
+        log.warning(
+            "re-dispatching %d stages onto %s after: %s",
+            len(stages),
+            devices,
+            cause,
+        )
+        pipe = Pipeline(stages, params, devices, self.config)
+        self.last_pipeline = pipe
+        return pipe
 
     # -- streaming (the reference's run_defer contract) ------------------
 
@@ -175,6 +234,7 @@ class DEFER:
 
     def _stream_loop(self, pipe, input_stream, emit, retirer, monitor, tracer):
         since_probe = 0
+        retries_left = self.config.redispatch_attempts
         while not self._stop.is_set():
             try:
                 item = input_stream.get(timeout=0.05)
@@ -186,7 +246,30 @@ class DEFER:
                 break
             monitor.submitted()
             tracer.tick()
-            emit(retirer.add(pipe(item)))
+            while True:
+                try:
+                    emit(retirer.add(pipe(item)))
+                    break
+                except Exception as e:  # noqa: BLE001 — recovery below
+                    if retries_left <= 0:
+                        raise
+                    retries_left -= 1
+                    # Completed results (including the barrier-failure
+                    # spill) are still valid — emit them before
+                    # dropping what can no longer finish.
+                    try:
+                        emit(retirer.collect())
+                    except Exception:  # noqa: BLE001 — dead buffers
+                        pass
+                    lost = retirer.discard()
+                    if lost:
+                        log.warning(
+                            "dropping %d in-flight results of the failed "
+                            "pipeline",
+                            lost,
+                        )
+                        monitor.dropped(lost)
+                    pipe = self._redispatch(e)
             monitor.check()
             since_probe += 1
             if (

@@ -38,6 +38,11 @@ class DeferConfig:
       collective_timeout_s: watchdog timeout for a stage/transfer that
         never completes (the reference has no failure detection at all;
         a dead node hangs it forever — reference src/node.py:30-31).
+      redispatch_attempts: on a stage failure during run_defer, probe
+        device health and rebuild the pipeline on the healthy devices
+        up to this many times, retrying the failed microbatch (elastic
+        recovery; results in flight at failure time may be lost and the
+        retried input re-runs from stage 0). 0 = fail fast.
     """
 
     compute_dtype: Any = jnp.bfloat16
@@ -53,6 +58,7 @@ class DeferConfig:
     probe_every: int = 0
     donate_activations: bool = True
     collective_timeout_s: float = 120.0
+    redispatch_attempts: int = 1
 
     def replace(self, **kw: Any) -> "DeferConfig":
         return dataclasses.replace(self, **kw)

@@ -119,6 +119,7 @@ int defer_preprocess(const uint8_t* src, int64_t n, int64_t h, int64_t w,
   if (!src || !dst || n < 0 || h <= 0 || w <= 0 || c <= 0 || size <= 0) {
     return 1;
   }
+  if (n == 0) return 0;  // nothing to do (and no zero-size pool math)
   // Short-side resize dims, then centered crop offsets (matching
   // _resize_center_crop; std::nearbyint under the default FP
   // environment rounds half-to-even, like Python's round()).

@@ -48,6 +48,14 @@ class ProgressMonitor:
             self._outstanding -= 1
             self._last_progress = time.monotonic()
 
+    def dropped(self, n: int) -> None:
+        """Credit n submitted microbatches that were abandoned (elastic
+        re-dispatch discards in-flight work) so the watchdog does not
+        hold the recovered loop accountable for them forever."""
+        with self._lock:
+            self._outstanding -= n
+            self._last_progress = time.monotonic()
+
     def check(self) -> None:
         with self._lock:
             stalled = (

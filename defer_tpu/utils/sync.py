@@ -103,13 +103,17 @@ class Retirer:
         self.depth = depth
         self.sync = sync
         self.pending: collections.deque[Any] = collections.deque()
+        # Completed results rescued when a barrier raised mid-add —
+        # returned by the next collect() instead of being lost.
+        self._spill: list[Any] = []
 
     def __len__(self) -> int:
         return len(self.pending)
 
     def ready_count(self) -> int:
-        """Length of the known-completed prefix."""
-        n = 0
+        """Length of the known-completed prefix (including any
+        barrier-failure spill)."""
+        n = len(self._spill)
         for item in self.pending:
             if not item.is_ready():
                 break
@@ -132,13 +136,22 @@ class Retirer:
         out = self.collect()
         if len(self.pending) >= self.depth:
             target = self.pending[len(self.pending) // 2]
-            self.sync(target)
+            try:
+                self.sync(target)
+            except BaseException:
+                # The already-collected prefix is COMPLETED work; park
+                # it so a recovering caller's next collect() emits it
+                # rather than losing it with the raise.
+                self._spill = out + self._spill
+                raise
             out.extend(self._pop_through(target))
         return out
 
     def collect(self) -> list[Any]:
-        """Retire the known-ready prefix without blocking."""
-        out = []
+        """Retire the known-ready prefix (plus any barrier-failure
+        spill) without blocking."""
+        out = self._spill
+        self._spill = []
         while self.pending and self.pending[0].is_ready():
             out.append(self.pending.popleft())
         return out
@@ -147,6 +160,18 @@ class Retirer:
         """Barrier on the newest item and retire everything."""
         if self.pending:
             self.sync(self.pending[-1])
-        out = list(self.pending)
+        out = self._spill + list(self.pending)
+        self._spill = []
         self.pending.clear()
         return out
+
+    def discard(self) -> int:
+        """Drop every pending item WITHOUT syncing; returns the count.
+
+        For failure recovery: in-flight results of a dead pipeline can
+        neither complete nor be waited on — the caller re-dispatches
+        and accepts the loss (the reference loses the same microbatches
+        by hanging forever, reference src/node.py:102-103)."""
+        n = len(self.pending)
+        self.pending.clear()
+        return n

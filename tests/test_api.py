@@ -130,3 +130,58 @@ def test_stage_failure_surfaces_cleanly(devices):
     t.join(timeout=120)
     assert not t.is_alive(), "run_defer hung on an injected stage failure"
     assert errors and "injected stage failure" in str(errors[0])
+
+
+_FLAKY = {"failures": 0}
+
+
+def test_stage_failure_redispatches_and_recovers(devices):
+    """Elastic recovery: a transiently failing stage triggers a health
+    probe + pipeline rebuild and the failed microbatch is retried —
+    the reference hangs forever on any node death (reference
+    src/node.py:102-103); fail-fast (redispatch_attempts=0) is the
+    other mode, covered by test_stage_failure_surfaces_cleanly."""
+    import numpy as np
+
+    from defer_tpu.graph.ir import GraphBuilder
+    from defer_tpu.ops.registry import op_names, register_op
+
+    if "flaky" not in op_names():
+        @register_op("flaky")
+        def flaky_apply(params, inputs, attrs):
+            if _FLAKY["failures"] > 0:
+                _FLAKY["failures"] -= 1
+                raise RuntimeError("transient stage failure")
+            return inputs[0]
+
+    _FLAKY["failures"] = 1  # first build fails, rebuild heals
+
+    b = GraphBuilder("flaky_model")
+    x = b.input()
+    h = b.add("dense", x, name="s0", features=4)
+    h = b.add("flaky", h, name="wobble")
+    g = b.build(h)
+    params = {
+        "input": {}, "wobble": {},
+        "s0": {"kernel": jnp.ones((8, 4)), "bias": jnp.zeros(4)},
+    }
+
+    defer = DEFER(devices[:2], config=DeferConfig(compute_dtype=jnp.float32))
+    inq, outq = queue.Queue(), queue.Queue()
+    xin = jnp.ones((2, 8))
+    inq.put(xin)
+    inq.put(xin)
+    inq.put(None)
+
+    t = threading.Thread(
+        target=defer.run_defer, args=(g, ["s0"], inq, outq),
+        kwargs={"params": params}, daemon=True,
+    )
+    t.start()
+    outs = [outq.get(timeout=120), outq.get(timeout=120)]
+    t.join(timeout=60)
+    assert not t.is_alive()
+    assert _FLAKY["failures"] == 0
+    want = np.asarray(g.apply(params, xin))
+    for got in outs:
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
