@@ -296,19 +296,29 @@ def run_local_inference(
     duration_s: float = 10.0,
     params: GraphParams | None = None,
     compute_dtype: Any = None,
+    example: Any = None,
 ) -> dict[str, float]:
     """Single-device baseline: jit the whole model on one core and loop.
 
     The analogue of the reference's `local_infer.py` (reference
-    src/local_infer.py:16-23: loop `model.predict` for 10 min, count
-    results) — this defines the denominator of every speedup claim.
+    src/local_infer.py:16-23: preprocess one real image, loop
+    `model.predict` for 10 min, count results) — this defines the
+    denominator of every speedup claim. `example` supplies the looped
+    input (e.g. a preprocessed real image batch); default is a ones
+    tensor of the model's input shape.
     """
     cfg = DeferConfig()
     if compute_dtype is not None:
         cfg = cfg.replace(compute_dtype=compute_dtype)
     if params is None:
         params = model.init(jax.random.key(0), batch_size=batch_size)
-    x = model.example_input(batch_size)
+    # Commit the example to device once — a host numpy example would
+    # otherwise re-transfer every iteration and skew the baseline.
+    x = (
+        jax.device_put(jnp.asarray(example))
+        if example is not None
+        else model.example_input(batch_size)
+    )
 
     def apply(p, v):
         if jnp.issubdtype(v.dtype, jnp.floating):

@@ -109,6 +109,20 @@ def _bilinear_resize_np(x: np.ndarray, nh: int, nw: int) -> np.ndarray:
     return top * (1 - wy) + bot * wy
 
 
+# Keras-weights input conventions per zoo family (reference models use
+# the preprocessing their checkpoints were trained with).
+_CAFFE_MODELS = ("resnet50", "resnet101", "resnet152", "vgg16", "vgg19")
+
+
+def preprocess_mode(model_name: str) -> str:
+    """Which imagenet_preprocess mode a zoo model's weights expect."""
+    if model_name in _CAFFE_MODELS:
+        return "caffe"
+    if model_name.startswith("efficientnet"):
+        return "unit"  # Rescaling(1/255) lives in the real Keras model
+    return "scale"
+
+
 def load_image_dir(
     path: str,
     *,

@@ -46,25 +46,14 @@ from defer_tpu.runtime.data import (
     imagenet_preprocess,
     load_image_dir,
     prefetch_to_device,
+    preprocess_mode,
 )
-
-# Keras-weights input conventions per zoo family.
-_CAFFE_MODELS = ("resnet50", "resnet101", "resnet152", "vgg16", "vgg19")
-
-
-def _preprocess_mode(model_name: str) -> str:
-    if model_name in _CAFFE_MODELS:
-        return "caffe"
-    if model_name.startswith("efficientnet"):
-        return "unit"  # Rescaling(1/255) lives in the real Keras model
-    return "scale"
-
 
 def image_stream(images_dir: str, model, batch: int):
     """Decode -> preprocess -> batch -> device-prefetch, cycling the
     directory forever (static shapes; prefetch overlaps host decode +
     transfer with device compute)."""
-    mode = _preprocess_mode(model.name)
+    mode = preprocess_mode(model.name)
     size = model.input_shape[0]
 
     def examples():

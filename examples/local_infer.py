@@ -22,6 +22,9 @@ if os.environ.get("JAX_PLATFORMS"):
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 import argparse
+import itertools
+
+import numpy as np
 
 from defer_tpu.api import run_local_inference
 from defer_tpu.models import get_model
@@ -32,12 +35,47 @@ def main() -> None:
     ap.add_argument("--model", default="resnet50")
     ap.add_argument("--minutes", type=float, default=10.0)
     ap.add_argument("--batch", type=int, default=1)
+    ap.add_argument(
+        "--images",
+        default=os.path.join(os.path.dirname(__file__), "images"),
+        help="directory of real images for the looped batch; "
+        "--synthetic feeds ones instead",
+    )
+    ap.add_argument("--synthetic", action="store_true")
     args = ap.parse_args()
 
+    model = get_model(args.model)
+    example = None
+    is_image_model = (
+        len(model.input_shape) == 3 and model.input_shape[-1] == 3
+    )
+    if not args.synthetic and is_image_model:
+        # The reference preprocesses one real image and loops on it
+        # (reference src/local_infer.py:10-14); same here, batched,
+        # with the preprocessing the model's weights expect.
+        from defer_tpu.runtime.data import (
+            imagenet_preprocess,
+            load_image_dir,
+            preprocess_mode,
+        )
+
+        imgs = itertools.cycle(load_image_dir(args.images))
+        example = np.concatenate(
+            [
+                imagenet_preprocess(
+                    next(imgs),
+                    size=model.input_shape[0],
+                    mode=preprocess_mode(model.name),
+                )
+                for _ in range(args.batch)
+            ]
+        )
+
     stats = run_local_inference(
-        get_model(args.model),
+        model,
         batch_size=args.batch,
         duration_s=args.minutes * 60,
+        example=example,
     )
     print(f"{stats['count']:.0f} results in {args.minutes} min")
     print(f"Throughput: {stats['items_per_sec']:.2f} images/sec")
