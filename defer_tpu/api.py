@@ -319,6 +319,10 @@ def run_local_inference(
         if example is not None
         else model.example_input(batch_size)
     )
+    # Count what actually runs — a caller-supplied example's leading
+    # dim is the real batch; trusting batch_size would silently scale
+    # the baseline metric.
+    batch_size = int(x.shape[0]) if getattr(x, "ndim", 0) > 0 else 1
 
     def apply(p, v):
         if jnp.issubdtype(v.dtype, jnp.floating):

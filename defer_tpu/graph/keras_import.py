@@ -587,7 +587,7 @@ def model_from_keras(
     """
     import jax
 
-    from defer_tpu.graph.partition import articulation_points
+    from defer_tpu.graph.partition import chain_boundaries
     from defer_tpu.models import Model
 
     graph, input_shape = from_keras_json(text)
@@ -595,7 +595,10 @@ def model_from_keras(
         name=graph.name,
         graph=graph,
         input_shape=input_shape,
-        cut_candidates=tuple(articulation_points(graph)),
+        # Width-2 discovery keeps single-tensor articulation points as
+        # plain names and adds (a, b) bundles where no single tensor
+        # separates the chain (NASNet-class imports).
+        cut_candidates=tuple(chain_boundaries(graph, max_width=2)),
     )
     loaded = params
     if weights_h5 is not None:

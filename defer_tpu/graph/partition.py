@@ -177,34 +177,62 @@ def articulation_points(graph: Graph) -> list[str]:
     everything) but partition() cannot build a stage chain from it, so
     such nodes are excluded by design.
 
-    Single O(V+E) sweep: for a valid c every live node is comparable to
-    c, so anc(c) is exactly the topological prefix of live nodes ending
-    at c — c is valid iff, right after processing it, every still-open
-    edge (one whose consumer hasn't been processed) originates at c.
-    Edges into dead nodes are never consumed: a dead consumer lands on
-    the far side of every later cut while its producer stays on the
-    near side, which is exactly the crossing edge the ancestors-based
-    definition rejects.
+    This is exactly the width-1 case of chain_boundaries' frontier
+    sweep: a node is an articulation point iff, right after it is
+    processed, it is the sole producer with open out-edges.
     """
+    return [
+        c for c in chain_boundaries(graph, max_width=1)
+        if isinstance(c, str)
+    ]
+
+
+def chain_boundaries(
+    graph: Graph, max_width: int = 2
+) -> list[CutSpec]:
+    """All valid chain boundaries up to `max_width` tensors, topo order.
+
+    Generalizes articulation_points to multi-tensor bundles: at each
+    topological position the *frontier* — live producers with an edge
+    still open to a later (or dead) consumer — is exactly the value
+    set a boundary there must relay. Width 1 is a single-tensor cut
+    (returned as a plain name); width 2..max_width is a bundle tuple.
+    This is the discovery that makes NASNet-class graphs pipelinable
+    without hand-written cut lists: no single tensor separates the
+    cell chain, but the (cell_i, cell_i-1) frontier does.
+
+    Edges into dead nodes (non-ancestors of the output) are never
+    closed, keeping discovery consistent with validate_cut_points:
+    a producer feeding a dead consumer must ride every later boundary.
+    """
+    if max_width < 1:
+        raise PartitionError("max_width must be >= 1")
     live = graph.ancestors(graph.output_name)
     consumers = graph.consumers()
-    total_open = 0
-    points: list[str] = []
+    topo_index = {node.name: i for i, node in enumerate(graph.nodes)}
+    open_edges: dict[str, int] = {}
+    frontier: set[str] = set()
+    out: list[CutSpec] = []
     for node in graph.nodes:
-        if node.name in live:
-            total_open -= len(node.inputs)
-        # At this instant none of this node's own out-edges can have
-        # been consumed yet, so "every open edge originates here" is
-        # exactly total_open == out_degree.
-        out_degree = len(consumers[node.name])
-        total_open += out_degree
+        if node.name not in live:
+            continue  # dead consumers never close their in-edges
+        for inp in node.inputs:
+            open_edges[inp] -= 1
+            if open_edges[inp] == 0:
+                frontier.discard(inp)
+        deg = len(consumers[node.name])
+        if deg:
+            open_edges[node.name] = deg
+            frontier.add(node.name)
+        if node.name == graph.output_name:
+            continue
         if (
-            node.name in live
-            and node.name not in (graph.input_name, graph.output_name)
-            and total_open == out_degree
+            1 <= len(frontier) <= max_width
+            and graph.input_name not in frontier
         ):
-            points.append(node.name)
-    return points
+            members = sorted(frontier, key=topo_index.__getitem__)
+            out.append(members[0] if len(members) == 1 else tuple(members))
+    return out
 
 
 def partition(

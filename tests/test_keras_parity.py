@@ -126,3 +126,21 @@ def test_native_zoo_consumes_real_checkpoint(name, keras_artifacts):
         x = x / 255.0
     y = model.graph.apply(params, x)
     _assert_close(y, y_tf, name)
+
+
+def test_imported_nasnet_pipelines_via_bundle_discovery(keras_artifacts):
+    """A real NASNetMobile (no single-tensor cut inside the cell run)
+    imports with auto-discovered bundle boundaries and a 4-stage
+    bundle pipeline reproduces the full forward — the reference's wire
+    protocol (one activation per hop) cannot express this at all."""
+    from defer_tpu.graph.partition import partition, stage_params
+
+    json_str, weights_path, y_tf, x = keras_artifacts("nasnet_mobile")
+    model, params = model_from_keras(json_str, weights_h5=weights_path)
+    assert any(isinstance(c, tuple) for c in model.cut_candidates)
+    cuts = model.default_cuts(4)
+    assert len(cuts) == 3
+    h = x
+    for s in partition(model.graph, cuts):
+        h = s.apply(stage_params(params, s), h)
+    _assert_close(h, y_tf, "nasnet_mobile.pipeline4")
