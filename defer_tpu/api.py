@@ -76,8 +76,16 @@ class DEFER:
         The analogue of `_partition` + `_dispatchModels` (reference
         src/dispatcher.py:30-73): cut points become stage graphs, weight
         shipping becomes `device_put` of each stage's param slice.
+
+        partition_layers="auto" picks FLOPs-balanced boundaries from
+        the discovered candidates, one stage per device — the cut list
+        the reference makes the user find by hand (reference
+        src/test.py:24-28).
         """
-        cuts = normalize_cuts(partition_layers)
+        auto = (
+            isinstance(partition_layers, str) and partition_layers == "auto"
+        )
+        cuts = () if auto else normalize_cuts(partition_layers)
         if isinstance(model, str):
             # The reference's wire format: a Keras model.to_json()
             # string (reference src/dispatcher.py:52).
@@ -100,6 +108,36 @@ class DEFER:
                 # to the storage dtype at placement.
                 param_dtype=jnp.float32,
             )
+        if auto:
+            from defer_tpu.graph.partition import chain_boundaries
+            from defer_tpu.utils.flops import balanced_cuts
+
+            n_dev = len(
+                self.devices if self.devices is not None else jax.devices()
+            )
+            cands = (
+                model.cut_candidates
+                if isinstance(model, Model) and model.cut_candidates
+                else chain_boundaries(graph)
+            )
+            n_stages = min(n_dev, len(cands) + 1)
+            if example is None:
+                raise ValueError(
+                    'partition_layers="auto" needs a Model (a raw Graph '
+                    "has no input shape to balance FLOPs against)"
+                )
+            ex_leaf = jax.tree_util.tree_leaves(example)[0]
+            cuts = tuple(
+                balanced_cuts(
+                    graph,
+                    params,
+                    tuple(int(d) for d in ex_leaf.shape),
+                    n_stages,
+                    cands,
+                    input_dtype=ex_leaf.dtype,
+                )
+            )
+            log.info("auto cuts (%d stages): %s", n_stages, cuts)
         stages = partition(graph, cuts) if cuts else [graph]
         devices = pipeline_devices(len(stages), self.devices)
         log.info(

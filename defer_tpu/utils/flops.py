@@ -92,6 +92,73 @@ def graph_flops(
     return total
 
 
+def balanced_cuts(
+    graph: Graph,
+    params: GraphParams,
+    input_shape: Sequence[int],
+    num_stages: int,
+    candidates: Sequence[Any] | None = None,
+    input_dtype: Any = None,
+) -> list[Any]:
+    """Pick num_stages-1 boundaries that split the graph into stages of
+    near-equal FLOPs (not equal candidate COUNT — the index-even picks
+    of Model.default_cuts give ResNet50's early high-resolution convs
+    far more work than the tail). Candidates default to
+    chain_boundaries(graph); each is scored by the cumulative FLOPs of
+    everything at or before its last member, and the picks closest to
+    the i/num_stages fractions win (kept strictly increasing).
+    """
+    from defer_tpu.graph.partition import chain_boundaries
+
+    if num_stages < 1:
+        raise ValueError("num_stages must be >= 1")
+    if num_stages == 1:
+        return []
+    if candidates is None:
+        candidates = chain_boundaries(graph)
+    if num_stages - 1 > len(candidates):
+        raise ValueError(
+            f"{len(candidates)} candidate boundaries cannot make "
+            f"{num_stages} stages"
+        )
+    import jax.numpy as jnp
+
+    specs = graph.infer_shapes(
+        params,
+        input_shape,
+        dtype=jnp.float32 if input_dtype is None else input_dtype,
+    )
+    cum: dict[str, float] = {}
+    running = 0.0
+    for node in graph.nodes:
+        running += node_flops(
+            node.op, params.get(node.name, {}), specs[node.name].shape
+        )
+        cum[node.name] = running
+    total = running
+
+    def score(cand) -> float:
+        members = (cand,) if isinstance(cand, str) else cand
+        return max(cum[m] for m in members)
+
+    scores = [score(c) for c in candidates]
+    picks: list[int] = []
+    prev = -1
+    remaining = num_stages - 1
+    for k in range(1, num_stages):
+        target = total * k / num_stages
+        # Best candidate for this fraction that still leaves room for
+        # the remaining picks and stays after the previous one.
+        lo = prev + 1
+        hi = len(candidates) - (remaining - len(picks) - 1)
+        best = min(
+            range(lo, hi), key=lambda i: abs(scores[i] - target)
+        )
+        picks.append(best)
+        prev = best
+    return [candidates[i] for i in picks]
+
+
 def transformer_flops(
     *,
     num_layers: int,

@@ -185,3 +185,21 @@ def test_stage_failure_redispatches_and_recovers(devices):
     want = np.asarray(g.apply(params, xin))
     for got in outs:
         np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+
+
+def test_auto_cuts_builds_balanced_pipeline(devices):
+    """partition_layers="auto": FLOPs-balanced boundaries, one stage per
+    device — the cut list the reference makes the user find by hand
+    (reference src/test.py:24-28)."""
+    import numpy as np
+
+    from defer_tpu.models import get_model
+
+    model = get_model("mobilenetv2")
+    defer = DEFER(devices[:4], config=DeferConfig(compute_dtype=jnp.float32))
+    params = model.init(jax.random.key(0))
+    pipe, example = defer.build_pipeline(model, "auto", params=params)
+    assert pipe.num_stages == 4
+    got = np.asarray(pipe.warmup(example))
+    want = np.asarray(model.graph.apply(params, example))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)

@@ -353,3 +353,31 @@ def test_chain_boundaries_agrees_with_articulation_points():
         if isinstance(c, str)
     ]
     assert singles == articulation_points(model.graph)
+
+
+def test_balanced_cuts_evens_stage_flops():
+    """FLOPs-balanced picks beat index-even picks on VGG16 (whose conv
+    blocks are very uneven) and stay valid boundaries."""
+    from defer_tpu.models import get_model
+    from defer_tpu.utils.flops import balanced_cuts, node_flops
+
+    m = get_model("vgg16")
+    p = m.init(jax.random.key(0))
+    shape = (1, *m.input_shape)
+    specs = m.graph.infer_shapes(p, shape)
+
+    def imbalance(cuts):
+        per_stage = [
+            sum(
+                node_flops(n.op, p.get(n.name, {}), specs[n.name].shape)
+                for n in s.nodes
+                if n.op != "input"
+            )
+            for s in partition(m.graph, cuts)
+        ]
+        return max(per_stage) / min(per_stage)
+
+    naive = imbalance(m.default_cuts(4))
+    bal_cuts = balanced_cuts(m.graph, p, shape, 4, m.cut_candidates)
+    validate_cut_points(m.graph, bal_cuts)
+    assert imbalance(bal_cuts) < naive
