@@ -73,8 +73,9 @@ def main() -> None:
     ap.add_argument(
         "--cuts",
         default=None,
-        help="comma-separated cut layers (reference test.py's part_at); "
-        "default: one stage per visible device",
+        help="comma-separated cut layers (reference test.py's part_at), "
+        "or 'auto' for FLOPs-balanced boundaries; default: one stage "
+        "per visible device from the model's candidate list",
     )
     ap.add_argument("--minutes", type=float, default=5.0)
     ap.add_argument("--batch", type=int, default=1)
@@ -92,12 +93,17 @@ def main() -> None:
 
     model = get_model(args.model)
     n_dev = len(jax.devices())
-    cuts = (
-        args.cuts.split(",")
-        if args.cuts
-        else model.default_cuts(min(n_dev, len(model.cut_candidates) + 1))
-    )
-    print(f"{args.model}: {len(cuts) + 1} stages over {n_dev} device(s)")
+    if args.cuts == "auto":
+        cuts = "auto"
+        print(f"{args.model}: auto (FLOPs-balanced) stages over "
+              f"{n_dev} device(s)")
+    else:
+        cuts = (
+            args.cuts.split(",")
+            if args.cuts
+            else model.default_cuts(min(n_dev, len(model.cut_candidates) + 1))
+        )
+        print(f"{args.model}: {len(cuts) + 1} stages over {n_dev} device(s)")
 
     defer = DEFER()
     # The reference sizes these 10 deep for backpressure (test.py:44-45).
