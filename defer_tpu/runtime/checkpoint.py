@@ -25,6 +25,7 @@ from typing import Any, Mapping
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import SingleDeviceSharding
 
 from defer_tpu.runtime import codec
 
@@ -146,7 +147,9 @@ def _shard_index_spans(
     )
 
 
-def save_sharded(dirpath: str, tree: Any, *, level: int = 3) -> None:
+def save_sharded(
+    dirpath: str, tree: Any, *, level: int = 3, save_id: Any = None
+) -> None:
     """Checkpoint a pytree of (possibly distributed) jax.Arrays without
     gathering: each process writes one file holding only the shards it
     owns (replica_id == 0, so replicated data is stored exactly once
@@ -154,6 +157,11 @@ def save_sharded(dirpath: str, tree: Any, *, level: int = 3) -> None:
     shipping (reference src/dispatcher.py:60-63) but durable and
     distributed. Assumes a filesystem all hosts can read at restore
     (the standard multi-host checkpoint arrangement).
+
+    `save_id` (e.g. the training step — a value every process already
+    agrees on) is stamped into each shard's manifest; restore_sharded
+    rejects shard sets with mismatched ids, catching a save that died
+    after only some processes replaced their files.
     """
     os.makedirs(dirpath, exist_ok=True)
     entries = []
@@ -181,7 +189,11 @@ def save_sharded(dirpath: str, tree: Any, *, level: int = 3) -> None:
             )
             frames.append(frame)
     manifest = json.dumps(
-        {"process": jax.process_index(), "entries": entries}
+        {
+            "process": jax.process_index(),
+            "save_id": save_id,
+            "entries": entries,
+        }
     ).encode()
     # The process count rides in the filename so a restore can detect
     # stale shard files from an earlier save with a different job size
@@ -261,12 +273,21 @@ def restore_sharded(dirpath: str, like: Any) -> Any:
         needed[key] = spans
 
     pieces: dict[str, dict[tuple, np.ndarray]] = {}
+    save_ids: set[Any] = set()
     for name in names:
         with open(os.path.join(dirpath, name), "rb") as f:
             if f.read(len(_MAGIC)) != _MAGIC:
                 raise ValueError(f"{name!r} is not a defer_tpu checkpoint")
             (mlen,) = struct.unpack("<q", f.read(8))
-            entries = json.loads(f.read(mlen).decode())["entries"]
+            header = json.loads(f.read(mlen).decode())
+            save_ids.add(json.dumps(header.get("save_id")))
+            if len(save_ids) > 1:
+                raise ValueError(
+                    f"{dirpath!r} mixes shards from different saves "
+                    f"(save_ids {sorted(save_ids)}); a previous save "
+                    "likely died after replacing only some files"
+                )
+            entries = header["entries"]
             for e in entries:
                 span = tuple(tuple(s) for s in e["spans"])
                 if span not in needed.get(e["key"], ()):
@@ -283,8 +304,17 @@ def restore_sharded(dirpath: str, like: Any) -> Any:
         by_span = pieces.get(key)
         if by_span is None:
             raise KeyError(f"checkpoint has no shards for leaf {key!r}")
-        if sharding is None:
-            # Unsharded leaf: expect one full-array piece.
+        on_default_device = isinstance(
+            sharding, SingleDeviceSharding
+        ) and sharding.device_set == {jax.devices()[0]}
+        if sharding is None or on_default_device:
+            # Unsharded / default-single-device leaf: one full-array
+            # piece, restored UNCOMMITTED (a device_put-committed
+            # scalar would make the next jit reject it alongside
+            # multi-device params — fresh-init states carry
+            # uncommitted scalars). Non-default single-device leaves
+            # (per-stage pinned buffers) keep their device via the
+            # sharded branch below.
             full = by_span.get(tuple((0, d) for d in gshape))
             if full is None:
                 raise ValueError(

@@ -42,6 +42,13 @@ def main() -> None:
     ap.add_argument("--layers", type=int, default=4)
     ap.add_argument("--dim", type=int, default=128)
     ap.add_argument("--seq", type=int, default=64)
+    ap.add_argument(
+        "--ckpt-dir",
+        default=None,
+        help="save a sharded distributed checkpoint here every "
+        "--ckpt-every steps and resume from it if present",
+    )
+    ap.add_argument("--ckpt-every", type=int, default=10)
     args = ap.parse_args()
 
     n_dev = len(jax.devices())
@@ -64,6 +71,16 @@ def main() -> None:
     )
     state = init_state(jax.random.key(0))
 
+    import glob
+
+    if args.ckpt_dir and glob.glob(
+        os.path.join(args.ckpt_dir, "shards-*.defer")
+    ):
+        from defer_tpu.runtime.checkpoint import restore_sharded
+
+        state = restore_sharded(args.ckpt_dir, state)
+        print(f"resumed sharded state from {args.ckpt_dir}")
+
     num_mb = args.stages + 2
     batch = 4 * dp
     key = jax.random.key(1)
@@ -78,6 +95,13 @@ def main() -> None:
         state, loss = train_step(state, ids, labels)
         if step in (0, args.steps - 1) or step % 10 == 0:
             print(f"step {step}: loss {float(loss):.4f}")
+        if args.ckpt_dir and (step + 1) % args.ckpt_every == 0:
+            from defer_tpu.runtime.checkpoint import save_sharded
+
+            # The step is the cross-process save id: restore rejects a
+            # directory where only some processes finished a save.
+            save_sharded(args.ckpt_dir, state, save_id=step)
+            print(f"saved sharded checkpoint at step {step}")
     dt = time.perf_counter() - t0
     tokens = args.steps * num_mb * batch * args.seq
     print(f"{tokens / dt:.0f} tokens/sec over {args.steps} steps")

@@ -198,3 +198,35 @@ def test_sharded_restore_rejects_mixed_shard_sets(tmp_path, devices):
         f.write(b"junk")
     with pytest.raises(ValueError, match="mixed or incomplete"):
         restore_sharded(d, tree)
+
+
+def test_sharded_restored_train_state_is_jit_compatible(tmp_path, devices):
+    """Cross-process resume: a train step whose FIRST compile sees the
+    restored state must accept it — committed single-device scalars
+    next to 8-device params would be rejected by jit (regression)."""
+    import optax
+
+    from defer_tpu.models.bert import SpmdBert
+    from defer_tpu.parallel.mesh import make_mesh
+    from defer_tpu.parallel.train import make_train_step
+    from defer_tpu.parallel.transformer_stack import TransformerConfig
+    from defer_tpu.runtime.checkpoint import restore_sharded, save_sharded
+
+    mesh = make_mesh({"data": 2, "stage": 2, "model": 2}, devices)
+    cfg = TransformerConfig(
+        num_layers=4, dim=32, num_heads=4, ffn_dim=64, vocab_size=64,
+        max_len=16,
+    )
+    sb = SpmdBert(mesh, cfg, compute_dtype=jnp.float32)
+    init_state, train_step = make_train_step(sb, optax.adam(1e-3),
+                                             num_classes=4)
+    state = init_state(jax.random.key(0))
+    d = str(tmp_path / "ck")
+    save_sharded(d, state)
+    restored = restore_sharded(d, state)
+    ids = jax.random.randint(jax.random.key(1), (3, 4, 8), 0, 64)
+    labels = jax.random.randint(jax.random.key(2), (3, 4), 0, 4)
+    # First (and only) compile of this train_step sees the restored
+    # state — the failing case before the uncommitted-scalar fix.
+    _, loss = train_step(restored, ids, labels)
+    assert jnp.isfinite(loss)
