@@ -272,15 +272,28 @@ def run_bench() -> dict:
     # p50 includes a host sync round trip per call, which on tunneled
     # transports dwarfs the stage compute itself.
     try:
+        from defer_tpu.utils.flops import flops_by_node
+
+        per_node = flops_by_node(
+            model.graph, params, (best_batch, 224, 224, 3)
+        )
+        stage_fl = [
+            sum(per_node[n.name] for n in s.nodes if n.op != "input")
+            for s in stages
+        ]
         with trace():
             lat = pipe.probe_stage_latencies(
-                jnp.ones((best_batch, 224, 224, 3), jnp.float32), iters=10
+                jnp.ones((best_batch, 224, 224, 3), jnp.bfloat16), iters=10
             )
-        for r in lat:
+        for r, fl in zip(lat, stage_fl):
+            stage_mfu = (
+                fl / r["amortized_s"] / chip_peak if chip_peak else None
+            )
             log(
                 f"stage {r['stage']} amortized "
-                f"{r['amortized_s'] * 1e3:.2f} ms "
-                f"(sync p50 {r['p50_s'] * 1e3:.2f} ms "
+                f"{r['amortized_s'] * 1e3:.2f} ms"
+                + (f" (mfu {stage_mfu:.3f})" if stage_mfu is not None else "")
+                + f" (sync p50 {r['p50_s'] * 1e3:.2f} ms "
                 f"p99 {r['p99_s'] * 1e3:.2f} ms) on {r['device']}"
             )
     except Exception as e:  # noqa: BLE001 — diagnostics only

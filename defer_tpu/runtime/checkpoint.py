@@ -306,7 +306,7 @@ def restore_sharded(dirpath: str, like: Any) -> Any:
             raise KeyError(f"checkpoint has no shards for leaf {key!r}")
         on_default_device = isinstance(
             sharding, SingleDeviceSharding
-        ) and sharding.device_set == {jax.devices()[0]}
+        ) and sharding.device_set == {jax.local_devices()[0]}
         if sharding is None or on_default_device:
             # Unsharded / default-single-device leaf: one full-array
             # piece, restored UNCOMMITTED (a device_put-committed

@@ -78,18 +78,34 @@ def node_flops(
     return out_elems
 
 
+def flops_by_node(
+    graph: Graph,
+    params: GraphParams,
+    input_shape: Sequence[int],
+    input_dtype: Any = None,
+) -> dict[str, float]:
+    """Per-node forward FLOPs for one input of `input_shape` (batch dim
+    included), from the IR's single source of shape truth."""
+    import jax.numpy as jnp
+
+    specs = graph.infer_shapes(
+        params,
+        input_shape,
+        dtype=jnp.float32 if input_dtype is None else input_dtype,
+    )
+    return {
+        node.name: node_flops(
+            node.op, params.get(node.name, {}), specs[node.name].shape
+        )
+        for node in graph.nodes
+    }
+
+
 def graph_flops(
     graph: Graph, params: GraphParams, input_shape: Sequence[int]
 ) -> float:
-    """Total forward FLOPs for one input of `input_shape` (batch dim
-    included), from the IR's single source of shape truth."""
-    specs = graph.infer_shapes(params, input_shape)
-    total = 0.0
-    for node in graph.nodes:
-        total += node_flops(
-            node.op, params.get(node.name, {}), specs[node.name].shape
-        )
-    return total
+    """Total forward FLOPs for one input of `input_shape`."""
+    return sum(flops_by_node(graph, params, input_shape).values())
 
 
 def balanced_cuts(
@@ -121,19 +137,11 @@ def balanced_cuts(
             f"{len(candidates)} candidate boundaries cannot make "
             f"{num_stages} stages"
         )
-    import jax.numpy as jnp
-
-    specs = graph.infer_shapes(
-        params,
-        input_shape,
-        dtype=jnp.float32 if input_dtype is None else input_dtype,
-    )
+    per_node = flops_by_node(graph, params, input_shape, input_dtype)
     cum: dict[str, float] = {}
     running = 0.0
     for node in graph.nodes:
-        running += node_flops(
-            node.op, params.get(node.name, {}), specs[node.name].shape
-        )
+        running += per_node[node.name]
         cum[node.name] = running
     total = running
 
