@@ -56,6 +56,45 @@ def _clear_backends() -> None:
             pass
 
 
+class BackendInitHang(RuntimeError):
+    """Backend init exceeded its deadline (wedged transport) — distinct
+    from an ERROR raised by init, which is retryable."""
+
+
+def _want_cpu() -> bool:
+    want = os.environ.get("JAX_PLATFORMS", "")
+    return want.split(",")[0].strip() == "cpu" if want else False
+
+
+def _devices_with_deadline(timeout_s: float):
+    """jax.devices() bounded by a deadline: a wedged TPU tunnel HANGS
+    backend init rather than erroring, which would otherwise stall the
+    whole bench past the driver's timeout with no JSON line emitted."""
+    import threading
+
+    import jax
+
+    result: dict = {}
+
+    def probe() -> None:
+        try:
+            result["devs"] = jax.devices()
+        except BaseException as e:  # noqa: BLE001 — relayed below
+            result["err"] = e
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        raise BackendInitHang(
+            f"backend init did not complete within {timeout_s:.0f}s "
+            "(wedged TPU transport?)"
+        )
+    if "err" in result:
+        raise result["err"]
+    return result["devs"]
+
+
 def init_backend_with_retry(attempts: int = 3):
     """First backend use can fail transiently (remote TPU tunnel);
     retry with backoff instead of surfacing a stack trace as the
@@ -63,11 +102,11 @@ def init_backend_with_retry(attempts: int = 3):
     import jax
 
     want = os.environ.get("JAX_PLATFORMS", "")
-    want_cpu = want.split(",")[0].strip() == "cpu" if want else False
+    want_cpu = _want_cpu()
     delay = 5.0
     for i in range(attempts):
         try:
-            devs = jax.devices()
+            devs = _devices_with_deadline(180.0)
             if (
                 not want_cpu
                 and i < attempts - 1
@@ -84,6 +123,12 @@ def init_backend_with_retry(attempts: int = 3):
                 )
             log(f"backend: {jax.default_backend()}, devices: {devs}")
             return devs
+        except BackendInitHang:
+            # A HUNG init leaves its thread inside xla_bridge holding
+            # the module lock: every in-process retry (and
+            # clear_backends itself) would block on it forever. Fail
+            # now; main() falls back to a fresh CPU subprocess.
+            raise
         except Exception as e:  # noqa: BLE001
             if i == attempts - 1:
                 raise
@@ -241,9 +286,12 @@ def run_bench() -> dict:
         + (f"{peak / 1e12:.0f} TFLOP/s" if peak else "unknown")
     )
 
+    # DEFER_BENCH_FAST=1: bounded-time mode for the CPU-fallback path
+    # (a full 256-batch sweep on CPU would blow any driver timeout).
+    fast = os.environ.get("DEFER_BENCH_FAST") == "1"
     best_ips = 0.0
     best_batch = None
-    for batch in (1, 8, 32, 64, 128, 256):
+    for batch in (1, 8, 32) if fast else (1, 8, 32, 64, 128, 256):
         try:
             stats = _measure(pipe, batch)
         except Exception as e:  # noqa: BLE001 — keep the best-so-far
@@ -304,7 +352,7 @@ def run_bench() -> dict:
     # the available chips to quantify multi-stage dispatch overhead
     # even on a 1-chip host.
     multi = {}
-    if n_dev == 1:
+    if n_dev == 1 and not fast:
         try:
             ms_stages = 4
             ms_cuts = model.default_cuts(ms_stages)
@@ -331,11 +379,12 @@ def run_bench() -> dict:
             "batch": best_batch,
         }
 
-    try:
-        bert = bench_bert(devices)
-    except Exception as e:  # noqa: BLE001 — extra datapoint only
-        log(f"bert probe failed ({type(e).__name__}: {e})")
-        bert = None
+    bert = None
+    if not fast:
+        try:
+            bert = bench_bert(devices)
+        except Exception as e:  # noqa: BLE001 — extra datapoint only
+            log(f"bert probe failed ({type(e).__name__}: {e})")
 
     log("measuring single-CPU-device baseline (subprocess)...")
     cpu_ips = cpu_baseline_subprocess()
@@ -356,18 +405,54 @@ def run_bench() -> dict:
     }
 
 
+def cpu_fallback(err: str) -> dict | None:
+    """When the TPU is unreachable, measure on CPU in a fresh bounded
+    subprocess (this process's backend state may be wedged) so the
+    round still records a real number — clearly marked platform=cpu
+    with the TPU error attached — instead of nothing."""
+    log("TPU unavailable; falling back to a bounded CPU measurement")
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu", DEFER_BENCH_FAST="1",
+        DEFER_BENCH_NO_FALLBACK="1",
+    )
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True,
+            text=True,
+            env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            timeout=1200,
+        )
+        line = out.stdout.strip().splitlines()[-1]
+        result = json.loads(line)
+    except Exception as e:  # noqa: BLE001 — fall through to error JSON
+        log(f"cpu fallback failed too: {e!r}")
+        return None
+    result["tpu_error"] = err
+    return result
+
+
 def main() -> None:
     try:
         result = run_bench()
     except Exception as e:  # noqa: BLE001
         log(traceback.format_exc())
-        result = {
-            "metric": "resnet50_images_per_sec",
-            "value": None,
-            "unit": "images/sec",
-            "vs_baseline": None,
-            "error": f"{type(e).__name__}: {e}",
-        }
+        err = f"{type(e).__name__}: {e}"
+        result = None
+        if (
+            os.environ.get("DEFER_BENCH_NO_FALLBACK") != "1"
+            and not _want_cpu()
+        ):
+            result = cpu_fallback(err)
+        if result is None:
+            result = {
+                "metric": "resnet50_images_per_sec",
+                "value": None,
+                "unit": "images/sec",
+                "vs_baseline": None,
+                "error": err,
+            }
     print(json.dumps(result), flush=True)
 
 
