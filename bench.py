@@ -3,6 +3,16 @@
 stderr. The JSON line is always emitted — on failure it carries an
 `error` field instead of a number.
 
+Process structure: by default this file is a SUPERVISOR that re-execs
+itself as a measurement child (DEFER_BENCH_CHILD=1) and enforces two
+deadlines — total wall clock and max seconds between section
+completions. The child appends a JSON snapshot of its result-so-far to
+$DEFER_BENCH_SNAPSHOT after every section, so if any single section
+wedges the device transport (observed: a Mosaic kernel compile hanging
+the tunneled-TPU backend — killable only from outside the process),
+the supervisor kills the child and still emits the already-measured
+headline instead of timing out with nothing.
+
 Protocol (mirrors the reference's measurement design, reference
 src/test.py:30-41 and src/local_infer.py:16-23, adapted to TPU):
 
@@ -37,6 +47,41 @@ import traceback
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+CHILD_ENV = "DEFER_BENCH_CHILD"
+SNAPSHOT_ENV = "DEFER_BENCH_SNAPSHOT"
+
+
+def snapshot(result: dict) -> None:
+    """Append the result-so-far to the supervisor's snapshot file (one
+    JSON object per line; last line wins). Fsync so the line survives
+    the child being SIGKILLed mid-section."""
+    path = os.environ.get(SNAPSHOT_ENV)
+    if not path:
+        return
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(result) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+    except OSError as e:  # noqa: PERF203 — diagnostics only
+        log(f"snapshot write failed: {e}")
+
+
+def read_snapshot(path: str) -> dict | None:
+    """Last complete JSON line of the snapshot file, or None."""
+    try:
+        with open(path) as f:
+            lines = [ln for ln in f.read().splitlines() if ln.strip()]
+    except OSError:
+        return None
+    for ln in reversed(lines):
+        try:
+            return json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+    return None
 
 
 def _clear_backends() -> None:
@@ -314,6 +359,23 @@ def run_bench() -> dict:
     if best_batch is None:
         raise RuntimeError("no batch size measured successfully")
 
+    # Headline is in hand — snapshot it before the optional sections so
+    # a wedge in any of them can't cost the round its number.
+    result = {
+        "metric": (
+            f"resnet50_images_per_sec_pipeline_{n_stages}stage"
+            f"_batch{best_batch}"
+        ),
+        "value": round(best_ips, 2),
+        "unit": "images/sec",
+        "vs_baseline": None,
+        "mfu": round(best_ips * flops_per_image / peak, 4) if peak else None,
+        "platform": topo["backend"],
+        "multistage": None,
+        "bert_base": None,
+    }
+    snapshot(result)
+
     # Per-stage latency probe, under a device trace when requested
     # ($DEFER_TPU_TRACE=dir captures a TensorBoard profile of it).
     # amortized_s leads: it is the pipeline-relevant per-call cost;
@@ -351,7 +413,6 @@ def run_bench() -> dict:
     # chain, reference src/test.py:30-41): round-robin the stages over
     # the available chips to quantify multi-stage dispatch overhead
     # even on a 1-chip host.
-    multi = {}
     if n_dev == 1 and not fast:
         try:
             ms_stages = 4
@@ -363,46 +424,42 @@ def run_bench() -> dict:
                 DeferConfig(compute_dtype=jnp.bfloat16, max_inflight=128),
             )
             stats = _measure(ms_pipe, best_batch)
-            multi = {
+            result["multistage"] = {
                 "stages": ms_stages,
                 "images_per_sec": round(stats["items_per_sec"], 1),
                 "batch": best_batch,
             }
-            log(f"multi-stage pipeline: {multi}")
+            log(f"multi-stage pipeline: {result['multistage']}")
         except Exception as e:  # noqa: BLE001 — extra datapoint only
             log(f"multi-stage probe failed ({type(e).__name__}: {e})")
     elif n_stages > 1:
         # The headline itself is already the multi-stage pipeline.
-        multi = {
+        result["multistage"] = {
             "stages": n_stages,
             "images_per_sec": round(best_ips, 1),
             "batch": best_batch,
         }
-
-    bert = None
-    if not fast:
-        try:
-            bert = bench_bert(devices)
-        except Exception as e:  # noqa: BLE001 — extra datapoint only
-            log(f"bert probe failed ({type(e).__name__}: {e})")
+    snapshot(result)
 
     log("measuring single-CPU-device baseline (subprocess)...")
     cpu_ips = cpu_baseline_subprocess()
     log(f"cpu single-device: {cpu_ips:.2f} images/sec")
     north_star = 8.0 * cpu_ips if cpu_ips == cpu_ips else float("nan")
+    if north_star == north_star:
+        result["vs_baseline"] = round(best_ips / north_star, 3)
+    snapshot(result)
 
-    return {
-        "metric": f"resnet50_images_per_sec_pipeline_{n_stages}stage_batch{best_batch}",
-        "value": round(best_ips, 2),
-        "unit": "images/sec",
-        "vs_baseline": round(best_ips / north_star, 3)
-        if north_star == north_star
-        else None,
-        "mfu": round(best_ips * flops_per_image / peak, 4) if peak else None,
-        "platform": topo["backend"],
-        "multistage": multi or None,
-        "bert_base": bert,
-    }
+    # BERT goes LAST: it is the newest section and the one that first
+    # exposed the wedged-transport hang; everything above is already
+    # snapshotted if it strikes again.
+    if not fast:
+        try:
+            result["bert_base"] = bench_bert(devices)
+        except Exception as e:  # noqa: BLE001 — extra datapoint only
+            log(f"bert probe failed ({type(e).__name__}: {e})")
+    snapshot(result)
+
+    return result
 
 
 def cpu_fallback(err: str) -> dict | None:
@@ -415,6 +472,8 @@ def cpu_fallback(err: str) -> dict | None:
         os.environ, JAX_PLATFORMS="cpu", DEFER_BENCH_FAST="1",
         DEFER_BENCH_NO_FALLBACK="1",
     )
+    env[CHILD_ENV] = "1"  # run the measurement directly; timeout below
+    env.pop(SNAPSHOT_ENV, None)
     try:
         out = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],
@@ -433,18 +492,157 @@ def cpu_fallback(err: str) -> dict | None:
     return result
 
 
-def main() -> None:
+def supervise(
+    cmd: list[str] | None = None,
+) -> tuple[dict | None, str | None]:
+    """Run the measurement in a child process under two deadlines.
+
+    Returns (result, error): result is the child's final JSON on clean
+    exit, else its last snapshot (with a `truncated` note) if that
+    already carries a headline number; error describes what went wrong
+    (None on clean success). `cmd` overrides the child command (tests).
+    """
+    import tempfile
+
+    total_s = float(os.environ.get("DEFER_BENCH_DEADLINE_S", "1500"))
+    stall_s = float(os.environ.get("DEFER_BENCH_STALL_S", "660"))
+    fd, snap_path = tempfile.mkstemp(prefix="defer_bench_", suffix=".jsonl")
+    os.close(fd)
+    env = dict(os.environ)
+    env[CHILD_ENV] = "1"
+    env[SNAPSHOT_ENV] = snap_path
+    # Own process group: a deadline kill must take down measurement
+    # grandchildren too (e.g. the CPU-baseline subprocess), or they
+    # keep saturating cores under whatever measurement runs next.
+    proc = subprocess.Popen(
+        cmd or [sys.executable, os.path.abspath(__file__)],
+        stdout=subprocess.PIPE,
+        stderr=None,  # child diagnostics flow through to our stderr
+        text=True,
+        env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        start_new_session=True,
+    )
     try:
-        result = run_bench()
-    except Exception as e:  # noqa: BLE001
-        log(traceback.format_exc())
-        err = f"{type(e).__name__}: {e}"
-        result = None
+        return _wait_supervised(proc, snap_path, total_s, stall_s)
+    finally:
+        try:
+            os.unlink(snap_path)
+        except OSError:
+            pass
+
+
+def _kill_tree(proc: subprocess.Popen) -> None:
+    import signal
+
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except (OSError, ProcessLookupError):
+        proc.kill()
+
+
+def _wait_supervised(
+    proc: subprocess.Popen, snap_path: str, total_s: float, stall_s: float
+) -> tuple[dict | None, str | None]:
+    t0 = time.monotonic()
+    last_size = 0
+    last_progress = t0
+    error = None
+    while True:
+        try:
+            proc.wait(timeout=5.0)
+            break
+        except subprocess.TimeoutExpired:
+            pass
+        now = time.monotonic()
+        try:
+            size = os.path.getsize(snap_path)
+        except OSError:
+            size = last_size
+        if size != last_size:
+            last_size = size
+            last_progress = now
+        if now - t0 > total_s:
+            error = f"bench exceeded total deadline ({total_s:.0f}s)"
+        elif last_size > 0 and now - last_progress > stall_s:
+            # The stall clock only runs once the first snapshot exists:
+            # before that, backend-init retries plus the first XLA
+            # compiles can legitimately take many minutes on a slow
+            # tunneled TPU, and killing a healthy child there would
+            # trade a real TPU headline for a CPU fallback. Until the
+            # first snapshot, only the total deadline applies.
+            error = (
+                f"bench made no section progress for {stall_s:.0f}s "
+                "(wedged device transport?)"
+            )
+        if error:
+            log(f"supervisor: {error}; killing measurement child")
+            _kill_tree(proc)
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                # Uninterruptible child (D-state on a dead transport):
+                # abandon it and salvage the snapshot — emitting the
+                # headline matters more than reaping the corpse.
+                log("supervisor: child unreaped after SIGKILL; abandoning")
+            break
+    try:
+        out = proc.stdout.read() if proc.stdout else ""
+    except OSError:
+        out = ""
+    if error is None and proc.returncode == 0:
+        try:
+            return json.loads(out.strip().splitlines()[-1]), None
+        except (IndexError, json.JSONDecodeError):
+            error = "child emitted no parseable JSON line"
+    if error is None:
+        error = f"measurement child exited rc={proc.returncode}"
+        # The child prints an error-JSON line before dying on its own
+        # exceptions — prefer its self-description.
+        try:
+            child_line = json.loads(out.strip().splitlines()[-1])
+            if child_line.get("error"):
+                error = child_line["error"]
+        except (IndexError, json.JSONDecodeError):
+            pass
+    snap = read_snapshot(snap_path)
+    if snap is not None and snap.get("value") is not None:
+        snap["truncated"] = error
+        log(f"supervisor: using last snapshot despite: {error}")
+        return snap, None
+    return None, error
+
+
+def main() -> None:
+    if os.environ.get(CHILD_ENV) == "1":
+        # Measurement process: run directly; one JSON line on stdout.
+        try:
+            result = run_bench()
+        except Exception as e:  # noqa: BLE001
+            log(traceback.format_exc())
+            print(
+                json.dumps(
+                    {
+                        "metric": "resnet50_images_per_sec",
+                        "value": None,
+                        "unit": "images/sec",
+                        "vs_baseline": None,
+                        "error": f"{type(e).__name__}: {e}",
+                    }
+                ),
+                flush=True,
+            )
+            sys.exit(1)
+        print(json.dumps(result), flush=True)
+        return
+
+    result, err = supervise()
+    if result is None:
         if (
             os.environ.get("DEFER_BENCH_NO_FALLBACK") != "1"
             and not _want_cpu()
         ):
-            result = cpu_fallback(err)
+            result = cpu_fallback(err or "unknown failure")
         if result is None:
             result = {
                 "metric": "resnet50_images_per_sec",

@@ -48,7 +48,45 @@ def attention_reference(
 
 
 def _pallas_available() -> bool:
-    return jax.default_backend() == "tpu"
+    """True iff the default backend can actually run Mosaic kernels.
+
+    `DEFER_TPU_PALLAS=1/0` forces the answer either way. Otherwise the
+    backend must be a TPU *and* a directly-attached one: tunneled /
+    experimental PJRT plugins (e.g. the "axon" remote-TPU transport)
+    present themselves as platform "tpu" but cannot compile Mosaic —
+    a pallas_call HANGS the transport rather than erroring (observed on
+    TPU v5 lite behind axon), so probing at call time is not an option.
+    Such plugins are registered in xla_bridge under their own factory
+    name while the live client claims platform "tpu"; that mismatch is
+    the detection.
+    """
+    import os
+
+    forced = os.environ.get("DEFER_TPU_PALLAS")
+    if forced is not None:
+        return forced == "1"
+    if jax.default_backend() != "tpu":
+        return False
+    try:
+        from jax._src import xla_bridge as xb
+
+        backend = jax.extend.backend.get_backend()
+        for name, client in xb._backends.items():
+            if client is backend and name != backend.platform:
+                return False
+    except Exception as e:  # noqa: BLE001 — fail CLOSED: a false yes hangs
+        # If the probe breaks (jax internals moved), prefer the XLA
+        # path: wrongly disabling pallas costs some speed; wrongly
+        # enabling it on a tunneled backend hangs the transport.
+        import warnings
+
+        warnings.warn(
+            f"pallas platform probe failed ({e!r}); using the XLA "
+            "attention path — set DEFER_TPU_PALLAS=1 to force pallas",
+            stacklevel=2,
+        )
+        return False
+    return True
 
 
 def multi_head_attention(
