@@ -372,9 +372,53 @@ def run_bench() -> dict:
         "mfu": round(best_ips * flops_per_image / peak, 4) if peak else None,
         "platform": topo["backend"],
         "multistage": None,
+        "data_parallel": None,
         "bert_base": None,
     }
     snapshot(result)
+
+    # The pipeline sweep's own result, before any other strategy can
+    # take over the headline — the multistage datapoint below must
+    # report THIS, not whichever strategy won.
+    pipe_ips = best_ips
+    pipe_batch = best_batch
+
+    # Multi-chip: batch-sharded SPMD data parallelism (the idiomatic
+    # TPU strategy when the model fits one chip) usually beats an
+    # n-device pipeline for raw throughput — measure it and let the
+    # best strategy carry the headline.
+    if n_dev > 1:
+        try:
+            from defer_tpu.parallel.data_parallel import ShardedInference
+
+            dp = ShardedInference(
+                model.graph,
+                params,
+                devices,
+                DeferConfig(compute_dtype=jnp.bfloat16, max_inflight=128),
+            )
+            dp_batch = best_batch * n_dev
+            stats = _measure(dp, dp_batch)
+            dp_ips = stats["items_per_sec"]
+            result["data_parallel"] = {
+                "shards": n_dev,
+                "images_per_sec": round(dp_ips, 1),
+                "batch": dp_batch,
+                "mfu": round(dp_ips * flops_per_image / peak, 4)
+                if peak
+                else None,
+            }
+            log(f"data-parallel: {result['data_parallel']}")
+            if dp_ips > best_ips:
+                result["metric"] = (
+                    f"resnet50_images_per_sec_dp{n_dev}shard_batch{dp_batch}"
+                )
+                result["value"] = round(dp_ips, 2)
+                result["mfu"] = result["data_parallel"]["mfu"]
+                best_ips = dp_ips
+        except Exception as e:  # noqa: BLE001 — extra datapoint only
+            log(f"data-parallel probe failed ({type(e).__name__}: {e})")
+        snapshot(result)
 
     # Per-stage latency probe, under a device trace when requested
     # ($DEFER_TPU_TRACE=dir captures a TensorBoard profile of it).
@@ -433,11 +477,11 @@ def run_bench() -> dict:
         except Exception as e:  # noqa: BLE001 — extra datapoint only
             log(f"multi-stage probe failed ({type(e).__name__}: {e})")
     elif n_stages > 1:
-        # The headline itself is already the multi-stage pipeline.
+        # The pipeline sweep itself was the multi-stage measurement.
         result["multistage"] = {
             "stages": n_stages,
-            "images_per_sec": round(best_ips, 1),
-            "batch": best_batch,
+            "images_per_sec": round(pipe_ips, 1),
+            "batch": pipe_batch,
         }
     snapshot(result)
 
@@ -586,10 +630,16 @@ def _wait_supervised(
                 # headline matters more than reaping the corpse.
                 log("supervisor: child unreaped after SIGKILL; abandoning")
             break
-    try:
-        out = proc.stdout.read() if proc.stdout else ""
-    except OSError:
+    if proc.returncode is None:
+        # Unreaped child still holds the pipe's write end — a read
+        # would block until its (possibly never-coming) EOF, which is
+        # the exact no-JSON-line hang this supervisor exists to stop.
         out = ""
+    else:
+        try:
+            out = proc.stdout.read() if proc.stdout else ""
+        except OSError:
+            out = ""
     if error is None and proc.returncode == 0:
         try:
             return json.loads(out.strip().splitlines()[-1]), None

@@ -70,7 +70,8 @@ class DEFER:
         params: GraphParams | None = None,
         rng: jax.Array | None = None,
         batch_size: int = 1,
-    ) -> tuple[Pipeline, Any]:
+        replicas: int = 1,
+    ) -> tuple[Any, Any]:
         """Partition + compile; returns (pipeline, example_input).
 
         The analogue of `_partition` + `_dispatchModels` (reference
@@ -81,6 +82,12 @@ class DEFER:
         the discovered candidates, one stage per device — the cut list
         the reference makes the user find by hand (reference
         src/test.py:24-28).
+
+        replicas > 1 composes data parallelism with the stage chain:
+        the whole pipeline is replicated that many times (over
+        replicas x stages devices) and the stream fans microbatches
+        across replicas round-robin — the scaling axis the reference
+        doesn't have (its only lever is a deeper chain).
         """
         auto = (
             isinstance(partition_layers, str) and partition_layers == "auto"
@@ -139,15 +146,36 @@ class DEFER:
             )
             log.info("auto cuts (%d stages): %s", n_stages, cuts)
         stages = partition(graph, cuts) if cuts else [graph]
-        devices = pipeline_devices(len(stages), self.devices)
-        log.info(
-            "built %d stages over devices %s", len(stages), devices
-        )
-        pipe = Pipeline(stages, params, devices, self.config)
+        pipe = self._compile(stages, params, replicas, None)
         self.last_pipeline = pipe
         # Retained for elastic re-dispatch after a stage failure.
-        self._build_state = (stages, params)
+        self._build_state = (stages, params, replicas)
         return pipe, example
+
+    def _compile(
+        self,
+        stages: Sequence[Any],
+        params: GraphParams,
+        replicas: int,
+        device_pool: Sequence[jax.Device] | None,
+    ) -> Pipeline:
+        pool = device_pool if device_pool is not None else self.devices
+        if replicas > 1:
+            from defer_tpu.parallel.data_parallel import ReplicatedPipeline
+
+            devices = pipeline_devices(len(stages) * replicas, pool)
+            log.info(
+                "built %d stages x %d replicas over devices %s",
+                len(stages),
+                replicas,
+                devices,
+            )
+            return ReplicatedPipeline(
+                stages, params, devices, self.config, num_replicas=replicas
+            )
+        devices = pipeline_devices(len(stages), pool)
+        log.info("built %d stages over devices %s", len(stages), devices)
+        return Pipeline(stages, params, devices, self.config)
 
     # -- elastic recovery -------------------------------------------------
 
@@ -194,15 +222,16 @@ class DEFER:
             raise RuntimeError(
                 "re-dispatch impossible: no device passed the health probe"
             ) from cause
-        stages, params = self._build_state
-        devices = pipeline_devices(len(stages), healthy)
+        stages, params, replicas = self._build_state
         log.warning(
-            "re-dispatching %d stages onto %s after: %s",
+            "re-dispatching %d stages (x%d replicas) onto %d healthy "
+            "device(s) after: %s",
             len(stages),
-            devices,
+            replicas,
+            len(healthy),
             cause,
         )
-        pipe = Pipeline(stages, params, devices, self.config)
+        pipe = self._compile(stages, params, replicas, healthy)
         self.last_pipeline = pipe
         return pipe
 
@@ -217,15 +246,18 @@ class DEFER:
         *,
         params: GraphParams | None = None,
         rng: jax.Array | None = None,
+        replicas: int = 1,
     ) -> None:
         """Blocking stream loop: consume input_stream, produce
         output_stream. Ends on a None/STOP sentinel or `stop()`.
 
-        Signature mirrors reference src/dispatcher.py:120.
+        Signature mirrors reference src/dispatcher.py:120; `replicas`
+        adds the data-parallel axis (see build_pipeline).
         """
         self._stop.clear()
         pipe, _ = self.build_pipeline(
-            model, partition_layers, params=params, rng=rng
+            model, partition_layers, params=params, rng=rng,
+            replicas=replicas,
         )
         monitor = ProgressMonitor(self.config.collective_timeout_s)
 
@@ -249,7 +281,15 @@ class DEFER:
                     )
                 last_ready = ready
 
-        retirer = Retirer(self.config.max_inflight, sync=watchdog_sync)
+        # Replicated runtimes supply their own retirer bank: the shared
+        # windowed-barrier trick is only sound within one device program
+        # (see ReplicaRetirer in parallel/data_parallel.py).
+        make = getattr(pipe, "make_retirer", None)
+        retirer = (
+            make(self.config.max_inflight, watchdog_sync)
+            if make is not None
+            else Retirer(self.config.max_inflight, sync=watchdog_sync)
+        )
 
         def emit(items: Sequence[Any]) -> None:
             for out in items:
@@ -286,7 +326,7 @@ class DEFER:
             tracer.tick()
             while True:
                 try:
-                    emit(retirer.add(pipe(item)))
+                    emit(retirer.add(pipe.submit(item)))
                     break
                 except Exception as e:  # noqa: BLE001 — recovery below
                     if retries_left <= 0:

@@ -1,3 +1,7 @@
+from defer_tpu.parallel.data_parallel import (
+    ReplicatedPipeline,
+    ShardedInference,
+)
 from defer_tpu.parallel.mesh import (
     describe_topology,
     make_mesh,
@@ -5,4 +9,11 @@ from defer_tpu.parallel.mesh import (
 )
 from defer_tpu.parallel.pipeline import Pipeline
 
-__all__ = ["Pipeline", "describe_topology", "make_mesh", "pipeline_devices"]
+__all__ = [
+    "Pipeline",
+    "ReplicatedPipeline",
+    "ShardedInference",
+    "describe_topology",
+    "make_mesh",
+    "pipeline_devices",
+]

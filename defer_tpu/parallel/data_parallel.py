@@ -1,0 +1,292 @@
+"""Data-parallel inference: batch-sharded SPMD apply + replicated
+pipelines.
+
+The reference scales throughput exactly one way — deeper pipelines
+(more compute nodes in the chain, reference src/dispatcher.py:47-63).
+On TPU that is rarely the best mapping: a CNN's whole forward fits on
+one chip, so the idiomatic way to use N chips is to shard the BATCH
+over a "data" mesh axis and let XLA replicate the program (SURVEY.md §2
+lists this as the natural extension the reference lacks). Two runtimes:
+
+  * `ShardedInference` — ONE jitted program over a mesh: params
+    replicated, batch sharded over the data axis. Zero host
+    orchestration in the hot loop; XLA inserts any collectives. This is
+    the throughput-optimal strategy when the model fits one device.
+  * `ReplicatedPipeline` — R independent device-pinned pipeline
+    replicas (defer_tpu.parallel.pipeline.Pipeline) fed round-robin;
+    composes data parallelism with the heterogeneous stage chain when
+    the model does NOT fit one device (params spread over S devices,
+    R x S total). In-order output merging preserves the stream
+    contract.
+
+Both present the Pipeline surface (`__call__`, `stream`, `throughput`),
+so the DEFER facade and the bench harness drive them interchangeably.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Iterator, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from defer_tpu.config import DeferConfig
+from defer_tpu.graph.ir import Graph, GraphParams
+from defer_tpu.graph.partition import StageGraph
+from defer_tpu.parallel.mesh import make_mesh
+from defer_tpu.parallel.pipeline import (
+    Pipeline,
+    StreamMeasure,
+    cast_params_to_storage,
+)
+from defer_tpu.utils.logging import get_logger
+from defer_tpu.utils.sync import Retirer, hard_sync
+
+log = get_logger(__name__)
+
+
+class ReplicaRetirer:
+    """Retirer bank for interleaved multi-replica streams.
+
+    One Retirer per replica: the windowed-barrier trick ("sync one item,
+    retire everything enqueued before it") relies on device program
+    order, which only holds WITHIN one pipeline — a single shared
+    Retirer over round-robin submissions would retire (and count as
+    completed) items of a wedged sibling replica. Here each replica's
+    items retire against its own program order, and a rotation pointer
+    restores global stream order at emit time.
+
+    Presents the Retirer surface DEFER._stream_loop drives: add /
+    collect / flush / discard / ready_count.
+    """
+
+    def __init__(
+        self,
+        num_replicas: int,
+        depth: int,
+        sync: Any = hard_sync,
+    ):
+        # Per-replica depth: total in-flight stays ~depth overall.
+        per = max(1, depth // num_replicas)
+        self.retirers = [Retirer(per, sync) for _ in range(num_replicas)]
+        self._ready: list[list[Any]] = [[] for _ in range(num_replicas)]
+        self._add_at = 0
+        self._emit_at = 0
+
+    def _drain(self) -> list[Any]:
+        out = []
+        n = len(self.retirers)
+        while True:
+            r = self._emit_at % n
+            if not self._ready[r]:
+                break
+            out.append(self._ready[r].pop(0))
+            self._emit_at += 1
+        return out
+
+    def add(self, item: Any) -> list[Any]:
+        r = self._add_at % len(self.retirers)
+        self._add_at += 1
+        self._ready[r].extend(self.retirers[r].add(item))
+        return self._drain()
+
+    def collect(self) -> list[Any]:
+        for r, ret in enumerate(self.retirers):
+            self._ready[r].extend(ret.collect())
+        return self._drain()
+
+    def flush(self) -> list[Any]:
+        for r, ret in enumerate(self.retirers):
+            self._ready[r].extend(ret.flush())
+        return self._drain()
+
+    def discard(self) -> int:
+        """Drop everything not yet emitted (in-flight and stuck-behind-
+        a-gap results); returns the count, mirroring Retirer.discard."""
+        n = sum(ret.discard() for ret in self.retirers)
+        n += sum(len(p) for p in self._ready)
+        self._ready = [[] for _ in self.retirers]
+        # Re-align rotation: the stream restarts cleanly after a
+        # re-dispatch with no half-emitted round.
+        self._add_at = 0
+        self._emit_at = 0
+        return n
+
+    def ready_count(self) -> int:
+        return sum(ret.ready_count() for ret in self.retirers) + sum(
+            len(p) for p in self._ready
+        )
+
+    def __len__(self) -> int:
+        return sum(len(ret) for ret in self.retirers)
+
+
+class ShardedInference(StreamMeasure):
+    """Batch-sharded SPMD apply of a whole graph over a device mesh."""
+
+    def __init__(
+        self,
+        graph: Graph,
+        params: GraphParams,
+        devices: Sequence[jax.Device] | Mesh | None = None,
+        config: DeferConfig | None = None,
+        *,
+        data_axis: str = "data",
+    ):
+        self.config = config or DeferConfig()
+        if isinstance(devices, Mesh):
+            self.mesh = devices
+        else:
+            devs = (
+                list(devices) if devices is not None else list(jax.devices())
+            )
+            self.mesh = make_mesh({data_axis: len(devs)}, devs)
+        self.data_axis = data_axis
+        self.num_shards = self.mesh.shape[data_axis]
+        self.graph = graph
+        cd = self.config.compute_dtype
+
+        rep = NamedSharding(self.mesh, P())
+        # Replicate params once at placement (the analogue of the
+        # reference's one-time weight dispatch, src/dispatcher.py:47-63).
+        self.params = jax.device_put(
+            cast_params_to_storage(params, self.config), rep
+        )
+        self._in_sharding = NamedSharding(self.mesh, P(data_axis))
+
+        def apply(p, x):
+            if jnp.issubdtype(x.dtype, jnp.floating):
+                x = x.astype(cd)
+            return graph.apply(p, x)
+
+        self._fn = jax.jit(
+            apply,
+            in_shardings=(rep, self._in_sharding),
+            out_shardings=self._in_sharding,
+        )
+
+    def __call__(self, x: jax.Array) -> jax.Array:
+        """Apply to one batch (async). The leading dim must divide by
+        the data-axis size — pad at the driver if it doesn't."""
+        if x.shape[0] % self.num_shards:
+            raise ValueError(
+                f"batch {x.shape[0]} not divisible by {self.num_shards} "
+                f"data shards — pad the batch or resize the mesh"
+            )
+        return self._fn(self.params, x)
+
+    submit = __call__  # one SPMD program: no replica fan-out needed
+
+    def stream(
+        self, inputs: Iterable[Any], *, max_inflight: int | None = None
+    ) -> Iterator[jax.Array]:
+        depth = max_inflight or self.config.max_inflight
+        retirer = Retirer(depth)
+        for x in inputs:
+            yield from retirer.add(self(x))
+        yield from retirer.flush()
+
+
+class ReplicatedPipeline(StreamMeasure):
+    """R pipeline replicas over R x S devices, fed round-robin.
+
+    Output order is the input order: replica r gets microbatches
+    r, r+R, r+2R, ... and each replica is internally in-order, so
+    yielding one result per replica in dispatch rotation restores the
+    global stream order without any reordering buffer.
+    """
+
+    def __init__(
+        self,
+        stages: Sequence[Graph | StageGraph],
+        params: GraphParams,
+        devices: Sequence[jax.Device],
+        config: DeferConfig | None = None,
+        *,
+        num_replicas: int | None = None,
+    ):
+        self.config = config or DeferConfig()
+        n_stages = len(stages)
+        if num_replicas is None:
+            num_replicas = max(1, len(devices) // n_stages)
+        if num_replicas * n_stages > len(devices):
+            raise ValueError(
+                f"{num_replicas} replicas x {n_stages} stages needs "
+                f"{num_replicas * n_stages} devices, got {len(devices)}"
+            )
+        self.pipes = [
+            Pipeline(
+                stages,
+                params,
+                devices[r * n_stages : (r + 1) * n_stages],
+                self.config,
+            )
+            for r in range(num_replicas)
+        ]
+        log.info(
+            "replicated pipeline: %d replicas x %d stages over %d devices",
+            num_replicas,
+            n_stages,
+            num_replicas * n_stages,
+        )
+
+    @property
+    def num_replicas(self) -> int:
+        return len(self.pipes)
+
+    @property
+    def num_stages(self) -> int:
+        return self.pipes[0].num_stages
+
+    def __call__(self, x: jax.Array) -> jax.Array:
+        # Single-shot call: replica 0 (no fan-out to coordinate).
+        return self.pipes[0](x)
+
+    def submit(self, x: jax.Array) -> jax.Array:
+        """Round-robin one microbatch to the next replica. Callers that
+        submit through here (DEFER._stream_loop) retire results in
+        dispatch order, which IS global stream order."""
+        r = self._next_replica
+        self._next_replica = (r + 1) % len(self.pipes)
+        return self.pipes[r](x)
+
+    _next_replica = 0
+
+    def make_retirer(self, depth: int, sync: Any = hard_sync) -> ReplicaRetirer:
+        """The retirer matching round-robin `submit` order (one Retirer
+        per replica; see ReplicaRetirer). Stream loops that submit
+        through this runtime MUST retire through this, or a wedged
+        replica's unfinished work gets retired on a sibling's barrier.
+
+        Resets the submit rotation so the retirer's internal rotation
+        starts aligned; every failure path re-aligns via
+        ReplicaRetirer.discard() + a fresh pipeline."""
+        self._next_replica = 0
+        return ReplicaRetirer(len(self.pipes), depth, sync)
+
+    def stream(
+        self, inputs: Iterable[Any], *, max_inflight: int | None = None
+    ) -> Iterator[jax.Array]:
+        """Round-robin dispatch with a per-replica in-flight cap."""
+        depth = max_inflight or self.config.max_inflight
+        retirer = self.make_retirer(depth * len(self.pipes))
+        for x in inputs:
+            yield from retirer.add(self.submit(x))
+        yield from retirer.flush()
+
+    def warmup(self, x: Any) -> jax.Array:
+        # Every replica is its own jit/device placement — warm them all
+        # (StreamMeasure.warmup would only compile replica 0).
+        outs = [p(x) for p in self.pipes]
+        for o in outs:
+            hard_sync(o)
+        return outs[0]
+
+    def probe_stage_latencies(
+        self, x: Any, iters: int = 10
+    ) -> list[dict[str, float]]:
+        """Per-stage latencies of replica 0 (replicas are identical
+        programs on identical hardware)."""
+        return self.pipes[0].probe_stage_latencies(x, iters=iters)

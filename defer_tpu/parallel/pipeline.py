@@ -38,7 +38,61 @@ from defer_tpu.utils.sync import Retirer, hard_sync
 log = get_logger(__name__)
 
 
-class Pipeline:
+def cast_params_to_storage(params: Any, config: DeferConfig) -> Any:
+    """Cast floating-point param leaves to config.storage_dtype once at
+    placement time — casting inside every stage call would cost an
+    extra HBM pass per microbatch (~10% ResNet50 throughput on v5e)."""
+    sd = config.storage_dtype
+    if not jnp.issubdtype(sd, jnp.floating):
+        return params
+    return jax.tree_util.tree_map(
+        lambda a: a.astype(sd)
+        if jnp.issubdtype(a.dtype, jnp.floating)
+        else a,
+        params,
+    )
+
+
+class StreamMeasure:
+    """Shared warmup/throughput for anything with __call__ + stream
+    (Pipeline, ShardedInference, ReplicatedPipeline) — one definition
+    of the measurement protocol, the analogue of the reference's timed
+    result counting (reference src/test.py:33-41)."""
+
+    def warmup(self, x: Any) -> jax.Array:
+        """Compile (first XLA compile is slow; do it before timing —
+        the analogue of the reference's settling sleep, reference
+        src/dispatcher.py:126, but deterministic)."""
+        out = self(x)
+        hard_sync(out)
+        return out
+
+    def throughput(
+        self, x: Any, num_microbatches: int = 256
+    ) -> dict[str, float]:
+        self.warmup(x)
+        t0 = time.perf_counter()
+        n = 0
+        last = None
+        for out in self.stream(x for _ in range(num_microbatches)):
+            last = out
+            n += 1
+        # A true completion barrier: device program order guarantees the
+        # last output retires after every earlier same-program execution
+        # (replicated runtimes warm every replica above, and their last
+        # round covers each replica's tail).
+        hard_sync(last)
+        dt = time.perf_counter() - t0
+        batch = int(x.shape[0]) if hasattr(x, "shape") and x.ndim > 0 else 1
+        return {
+            "microbatches": n,
+            "seconds": dt,
+            "microbatches_per_sec": n / dt,
+            "items_per_sec": n * batch / dt,
+        }
+
+
+class Pipeline(StreamMeasure):
     """A chain of jit-compiled stages, each pinned to one device."""
 
     def __init__(
@@ -64,21 +118,10 @@ class Pipeline:
         # (latency probing re-times the same activation repeatedly).
         self._plain_fns: list[Any] = []
         for i, (stage, dev) in enumerate(zip(self.stages, self.devices)):
-            sp = stage_params(params, stage)
-            # Store parameters in config.storage_dtype (compute_dtype
-            # unless an explicit param_dtype overrides): casting fp32
-            # weights to bf16 inside every stage call costs an extra
-            # HBM pass per microbatch (~10% ResNet50 throughput on
-            # v5e); one cast at placement removes it.
-            sd = self.config.storage_dtype
-            if jnp.issubdtype(sd, jnp.floating):
-                sp = jax.tree_util.tree_map(
-                    lambda a: a.astype(sd)
-                    if jnp.issubdtype(a.dtype, jnp.floating)
-                    else a,
-                    sp,
-                )
-            sp = jax.device_put(sp, dev)
+            sp = jax.device_put(
+                cast_params_to_storage(stage_params(params, stage), self.config),
+                dev,
+            )
             self.stage_params.append(sp)
 
             def stage_apply(p, x, _stage=stage, _cd=cd):
@@ -129,6 +172,10 @@ class Pipeline:
                 h = fn(p, h)
         return h
 
+    # Uniform submission point for stream loops: replicated runtimes
+    # override this to fan successive microbatches across replicas.
+    submit = __call__
+
     def stream(
         self,
         inputs: Iterable[Any],
@@ -152,15 +199,7 @@ class Pipeline:
             yield from retirer.add(self(x))
         yield from retirer.flush()
 
-    def warmup(self, x: Any) -> jax.Array:
-        """Compile every stage (first XLA compile is slow; do it before
-        timing — the analogue of the reference's settling sleep,
-        reference src/dispatcher.py:126, but deterministic)."""
-        out = self(x)
-        hard_sync(out)
-        return out
-
-    # -- measurement -----------------------------------------------------
+    # -- measurement (warmup/throughput come from StreamMeasure) ---------
 
     def probe_stage_latencies(
         self, x: Any, iters: int = 10
@@ -201,28 +240,3 @@ class Pipeline:
             )
             h = fn(p, h)
         return results
-
-    def throughput(
-        self, x: Any, num_microbatches: int = 256
-    ) -> dict[str, float]:
-        """Measure end-to-end streaming throughput (microbatches/sec and
-        items/sec), the analogue of the reference's timed result counting
-        (reference src/test.py:33-41)."""
-        self.warmup(x)
-        t0 = time.perf_counter()
-        n = 0
-        last = None
-        for out in self.stream(x for _ in range(num_microbatches)):
-            last = out
-            n += 1
-        # A true completion barrier: device program order guarantees the
-        # last output retires after every earlier stage execution.
-        hard_sync(last)
-        dt = time.perf_counter() - t0
-        batch = int(x.shape[0]) if hasattr(x, "shape") and x.ndim > 0 else 1
-        return {
-            "microbatches": n,
-            "seconds": dt,
-            "microbatches_per_sec": n / dt,
-            "items_per_sec": n * batch / dt,
-        }

@@ -1,0 +1,205 @@
+"""Data-parallel inference on the 8-device CPU mesh.
+
+The reference's only scaling axis is pipeline depth; these cover the
+TPU-native alternative (batch sharding over a "data" mesh axis) and its
+composition with the heterogeneous pipeline (replicas x stages).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from defer_tpu.config import DeferConfig
+from defer_tpu.graph.partition import partition
+from defer_tpu.parallel.data_parallel import (
+    ReplicatedPipeline,
+    ShardedInference,
+)
+from defer_tpu.parallel.mesh import make_mesh
+from tests.test_partition import residual_chain
+
+F32 = DeferConfig(compute_dtype=jnp.float32)
+
+
+def test_sharded_inference_matches_single_device(devices):
+    g = residual_chain()
+    params = g.init(jax.random.key(0), (8, 8))
+    x = jax.random.normal(jax.random.key(1), (8, 8))
+    want = g.apply(params, x)
+    dp = ShardedInference(g, params, devices, config=F32)
+    assert dp.num_shards == 8
+    got = dp.warmup(x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5)
+    # The batch really is sharded: each shard holds 1/8 of dim 0.
+    shard_shapes = {s.data.shape for s in got.addressable_shards}
+    assert shard_shapes == {(1, *want.shape[1:])}
+    # Params really are replicated on all 8 devices.
+    leaf = jax.tree_util.tree_leaves(dp.params)[0]
+    assert leaf.sharding.device_set == set(devices)
+
+
+def test_sharded_inference_rejects_ragged_batch(devices):
+    g = residual_chain()
+    params = g.init(jax.random.key(0), (8, 8))
+    dp = ShardedInference(g, params, devices, config=F32)
+    with pytest.raises(ValueError, match="not divisible"):
+        dp(jnp.ones((6, 8)))
+
+
+def test_sharded_inference_existing_mesh_axis(devices):
+    """A caller-built mesh (e.g. shared with other jobs) works too."""
+    g = residual_chain()
+    params = g.init(jax.random.key(0), (4, 8))
+    mesh = make_mesh({"data": 4}, devices[:4])
+    dp = ShardedInference(g, params, mesh, config=F32)
+    x = jax.random.normal(jax.random.key(1), (4, 8))
+    np.testing.assert_allclose(
+        np.asarray(dp.warmup(x)),
+        np.asarray(g.apply(params, x)),
+        rtol=1e-5,
+    )
+
+
+def test_sharded_inference_stream_order(devices):
+    g = residual_chain()
+    params = g.init(jax.random.key(0), (8, 8))
+    dp = ShardedInference(g, params, devices, config=F32)
+    xs = [jnp.full((8, 8), float(i)) for i in range(12)]
+    outs = list(dp.stream(iter(xs), max_inflight=3))
+    assert len(outs) == 12
+    for x, out in zip(xs, outs):
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(g.apply(params, x)), rtol=1e-5
+        )
+
+
+def test_replicated_pipeline_matches_and_places(devices):
+    g = residual_chain()
+    params = g.init(jax.random.key(0), (2, 8))
+    stages = partition(g, ["add_1"])  # 2 stages
+    rp = ReplicatedPipeline(stages, params, devices, config=F32)
+    assert rp.num_replicas == 4  # 8 devices // 2 stages
+    assert rp.num_stages == 2
+    x = jax.random.normal(jax.random.key(1), (2, 8))
+    want = g.apply(params, x)
+    np.testing.assert_allclose(
+        np.asarray(rp.warmup(x)), np.asarray(want), rtol=1e-5
+    )
+    # Replicas occupy disjoint device pairs covering all 8.
+    seen = set()
+    for pipe in rp.pipes:
+        for d in pipe.devices:
+            assert d not in seen
+            seen.add(d)
+    assert seen == set(devices)
+
+
+def test_replicated_pipeline_stream_order(devices):
+    """Round-robin fan-out must not reorder the stream, including when
+    the input count isn't a multiple of the replica count."""
+    g = residual_chain()
+    params = g.init(jax.random.key(0), (1, 8))
+    stages = partition(g, ["add_1"])
+    rp = ReplicatedPipeline(
+        stages, params, devices[:6], config=F32, num_replicas=3
+    )
+    xs = [jnp.full((1, 8), float(i)) for i in range(17)]
+    outs = list(rp.stream(iter(xs), max_inflight=2))
+    assert len(outs) == 17
+    for x, out in zip(xs, outs):
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(g.apply(params, x)), rtol=1e-5
+        )
+
+
+def test_run_defer_with_replicas(devices):
+    """The reference-shaped API with the data-parallel axis: replicas=2
+    over a 2-stage cut uses 4 devices and keeps the queue contract,
+    output order, and values."""
+    import queue
+    import threading
+
+    from defer_tpu.api import DEFER
+
+    g = residual_chain()
+    params = g.init(jax.random.key(0), (1, 8))
+    defer = DEFER(config=F32)
+    inq: "queue.Queue" = queue.Queue(10)
+    outq: "queue.Queue" = queue.Queue()
+    t = threading.Thread(
+        target=defer.run_defer,
+        args=(g, ["add_1"], inq, outq),
+        kwargs={"params": params, "replicas": 2},
+        daemon=True,
+    )
+    t.start()
+    xs = [jnp.full((1, 8), float(i)) for i in range(9)]
+    for x in xs:
+        inq.put(x)
+    inq.put(None)
+    outs = [outq.get(timeout=120) for _ in range(9)]
+    t.join(timeout=120)
+    assert not t.is_alive()
+    assert defer.last_pipeline.num_replicas == 2
+    for x, out in zip(xs, outs):
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(g.apply(params, x)), rtol=1e-5
+        )
+
+
+def test_replica_retirer_orders_and_isolates(devices):
+    """ReplicaRetirer: global order restored across interleaved
+    replicas; each replica's barrier only ever syncs its own items (a
+    wedged sibling can't have its unfinished work retired — the sync
+    callback records which items it was asked to fetch)."""
+    from defer_tpu.parallel.data_parallel import ReplicaRetirer
+    from defer_tpu.utils.sync import hard_sync
+
+    rr = ReplicaRetirer(2, depth=4, sync=hard_sync)
+    items = [jnp.full((2,), float(i)) for i in range(10)]
+    out = []
+    for it in items:
+        out.extend(rr.add(it))
+    out.extend(rr.flush())
+    assert [int(np.asarray(o[0])) for o in out] == list(range(10))
+    # Isolation: replica r's Retirer must only ever hold r's items, so
+    # a barrier taken on one replica cannot retire a sibling's work.
+    owner = {}
+    rr2 = ReplicaRetirer(2, depth=2, sync=lambda a: None)
+    for i in range(6):
+        arr = jnp.full((1,), float(i))
+        owner[id(arr)] = i % 2
+        rr2.add(arr)
+    # Internal wiring: replica r's Retirer only ever holds r's items.
+    for r, ret in enumerate(rr2.retirers):
+        for item in ret.pending:
+            assert owner[id(item)] == r
+
+
+def test_replica_retirer_discard_realigns(devices):
+    from defer_tpu.parallel.data_parallel import ReplicaRetirer
+
+    rr = ReplicaRetirer(3, depth=30)
+    for i in range(4):
+        rr.add(jnp.full((1,), float(i)))
+    lost = rr.discard()
+    assert lost >= 0
+    assert len(rr) == 0 and rr.ready_count() == 0
+    # After a discard the rotation restarts at replica 0 — a fresh
+    # submit rotation (new pipeline post-redispatch) stays aligned.
+    out = []
+    for i in range(6):
+        out.extend(rr.add(jnp.full((1,), float(10 + i))))
+    out.extend(rr.flush())
+    assert [int(np.asarray(o[0])) for o in out] == list(range(10, 16))
+
+
+def test_replicated_pipeline_device_budget_checked(devices):
+    g = residual_chain()
+    params = g.init(jax.random.key(0), (1, 8))
+    stages = partition(g, ["add_1"])
+    with pytest.raises(ValueError, match="needs"):
+        ReplicatedPipeline(
+            stages, params, devices[:3], config=F32, num_replicas=2
+        )
