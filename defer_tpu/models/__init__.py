@@ -118,6 +118,7 @@ def _load_zoo() -> None:
         "nasnet",
         "resnet",
         "vgg",
+        "vit",
     ):
         importlib.import_module(f"defer_tpu.models.{mod}")
 

@@ -118,3 +118,20 @@ def take_token_apply(params, inputs, attrs):
     """Select one sequence position, e.g. the [CLS] token: (B,S,D)->(B,D)."""
     (x,) = inputs
     return x[:, int(attrs.get("index", 0)), :]
+
+
+def _cls_token_init(rng, attrs, in_shapes, param_dtype):
+    dim = in_shapes[0][-1]
+    return {"token": jax.random.normal(rng, (1, 1, dim), param_dtype) * 0.02}
+
+
+@register_op("cls_token", init=_cls_token_init)
+def cls_token_apply(params, inputs, attrs):
+    """Prepend a learned classification token: (B,S,D) -> (B,S+1,D)
+    (ViT's [class] embedding; no reference analogue — the reference zoo
+    is CNN-only)."""
+    (x,) = inputs
+    tok = jnp.broadcast_to(
+        params["token"].astype(x.dtype), (x.shape[0], 1, x.shape[-1])
+    )
+    return jnp.concatenate([tok, x], axis=1)

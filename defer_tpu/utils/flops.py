@@ -61,6 +61,9 @@ def node_flops(
             return out_elems
         in_features = k.shape[0]
         return 2.0 * out_elems * in_features
+    if op == "mha" and "wq" in node_params:
+        b, s, d = out_shape[-3], out_shape[-2], out_shape[-1]
+        return attention_flops(batch=b, seq_len=s, dim=d)
     kernels = [
         node_params[p] for p in _CONTRACTION_PARAMS if p in node_params
     ]
@@ -167,6 +170,18 @@ def balanced_cuts(
     return [candidates[i] for i in picks]
 
 
+def attention_flops(*, batch: int, seq_len: int, dim: int) -> float:
+    """One self-attention layer's forward FLOPs (head-count invariant):
+    4 QKVO projection matmuls at 2*B*S*D*D each + the two S x S
+    contractions (logits, weighted values) at 2*B*S*S*D each. The ONE
+    definition shared by per-node accounting (node_flops 'mha') and the
+    whole-stack formula (transformer_flops)."""
+    tokens = float(batch * seq_len)
+    return 2.0 * tokens * (4.0 * dim * dim) + 2.0 * tokens * (
+        2.0 * seq_len * dim
+    )
+
+
 def transformer_flops(
     *,
     num_layers: int,
@@ -182,8 +197,7 @@ def transformer_flops(
     (the standard 2*(4*D^2 + 2*S*D)*S*B + 2*2*D*F*S*B accounting)."""
     tokens = float(batch * seq_len)
     per_layer = (
-        2.0 * tokens * (4.0 * dim * dim)  # QKVO
-        + 2.0 * tokens * (2.0 * seq_len * dim)  # QK^T and AV
+        attention_flops(batch=batch, seq_len=seq_len, dim=dim)
         + 2.0 * tokens * (2.0 * dim * ffn_dim) * num_experts_active
     )
     total = num_layers * per_layer

@@ -1,0 +1,104 @@
+"""ViT family: build, correctness through the pipeline runtimes, and
+parameter-count sanity (beyond-reference zoo entry — the reference zoo
+is CNN-only, reference src/test.py:23)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from defer_tpu.config import DeferConfig
+from defer_tpu.graph.partition import partition, validate_cut_points
+from defer_tpu.models import get_model
+from defer_tpu.parallel.pipeline import Pipeline
+
+F32 = DeferConfig(compute_dtype=jnp.float32)
+
+
+def test_vit_b16_builds_with_expected_shapes():
+    model = get_model("vit_b16")
+    assert model.input_shape == (224, 224, 3)
+    params = model.graph.init(jax.random.key(0), (1, 224, 224, 3))
+    spec = model.graph.output_spec(params, (1, 224, 224, 3))
+    assert spec.shape == (1, 1000)
+    # Published ViT-B/16 size: ~86M params.
+    n = sum(
+        a.size
+        for node in params.values()
+        for a in jax.tree_util.tree_leaves(node)
+    )
+    assert 85e6 < n < 88e6, f"ViT-B/16 param count {n / 1e6:.1f}M"
+    # Patch embedding really is a 16x16/s16 conv onto 768 channels.
+    assert params["patch_embed"]["kernel"].shape == (16, 16, 3, 768)
+    for k in (2, 4, 6):
+        cuts = model.default_cuts(k)
+        assert len(cuts) == k - 1
+        validate_cut_points(model.graph, cuts)
+
+
+def test_vit_tiny_forward_and_cls_token():
+    model = get_model("vit_tiny")
+    params = model.graph.init(jax.random.key(0), (2, 32, 32, 3))
+    x = jax.random.normal(jax.random.key(1), (2, 32, 32, 3))
+    out = model.graph.apply(params, x)
+    assert out.shape == (2, 10)
+    # 4x4 grid of 8x8 patches + [class] token = 17 tokens.
+    assert params["position_embedding"]["table"].shape[0] == 17
+    # The class token actually participates: zeroing it changes the
+    # head output (it is the only token the head reads).
+    params2 = {
+        k: (
+            {"token": jnp.zeros_like(v["token"])}
+            if k == "class_token"
+            else v
+        )
+        for k, v in params.items()
+    }
+    out2 = model.graph.apply(params2, x)
+    assert not np.allclose(np.asarray(out), np.asarray(out2))
+
+
+def test_vit_pipeline_composes_across_devices(devices):
+    """Block-boundary cuts through the heterogeneous pipeline: composed
+    stages == single jit, with attention inside the stages."""
+    model = get_model("vit_tiny")
+    params = model.graph.init(jax.random.key(0), (2, 32, 32, 3))
+    x = jax.random.normal(jax.random.key(1), (2, 32, 32, 3))
+    want = jax.jit(model.graph.apply)(params, x)
+    cuts = model.default_cuts(4)
+    stages = partition(model.graph, cuts)
+    pipe = Pipeline(stages, params, devices[:4], config=F32)
+    got = pipe.warmup(x)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_vit_auto_partition_balances():
+    """partition_layers='auto' path: FLOPs-balanced cuts from the block
+    candidates (uniform blocks -> roughly uniform stages)."""
+    from defer_tpu.utils.flops import balanced_cuts, flops_by_node
+
+    model = get_model("vit_tiny")
+    params = model.graph.init(jax.random.key(0), (1, 32, 32, 3))
+    cuts = balanced_cuts(
+        model.graph, params, (1, 32, 32, 3), 2, model.cut_candidates
+    )
+    assert len(cuts) == 1
+    per = flops_by_node(model.graph, params, (1, 32, 32, 3))
+    stages = partition(model.graph, cuts)
+    loads = [
+        sum(per[n.name] for n in s.nodes if n.op != "input") for s in stages
+    ]
+    assert max(loads) / max(min(loads), 1.0) < 1.6
+
+
+def test_vit_mha_flops_counted():
+    """mha nodes must contribute their matmul FLOPs, not 1/elem."""
+    from defer_tpu.utils.flops import flops_by_node
+
+    model = get_model("vit_tiny")
+    params = model.graph.init(jax.random.key(0), (1, 32, 32, 3))
+    per = flops_by_node(model.graph, params, (1, 32, 32, 3))
+    s, d = 17, 64
+    want = 8 * s * d * d + 4 * s * s * d
+    assert per["block_0_mha"] == want
