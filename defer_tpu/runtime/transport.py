@@ -72,11 +72,20 @@ class ArraySender:
         *,
         compress: bool = True,
         level: int = 3,
+        quantize: str | None = None,
         connect_timeout_s: float = 30.0,
         retries: int = 10,
     ):
         self.compress = compress
         self.level = level
+        # Lossy int8 quantize-for-transfer (codec.SCHEME_Q8) — the DCN
+        # analogue of the reference's ZFP fixed-precision mode; only
+        # floating payloads are quantized, others pass through.
+        if quantize not in (None, "int8"):
+            # Fail at construction, not on the first float send
+            # mid-stream.
+            raise ValueError(f"unknown quantize mode {quantize!r}")
+        self.quantize = quantize
         last: Exception | None = None
         for attempt in range(retries):
             try:
@@ -96,8 +105,14 @@ class ArraySender:
 
     def send(self, arr: np.ndarray) -> None:
         # level=0 is the codec's raw-passthrough scheme.
+        a = np.asarray(arr)
+        quant = (
+            self.quantize
+            if self.quantize and np.issubdtype(a.dtype, np.floating)
+            else None
+        )
         frame = codec.encode(
-            np.asarray(arr), level=self.level if self.compress else 0
+            a, level=self.level if self.compress else 0, quantize=quant
         )
         with self._lock:
             self._sock.sendall(_HEADER.pack(_TAG_ARRAY, len(frame)) + frame)

@@ -78,3 +78,90 @@ def test_bfloat16_via_view(native):
     view = np.asarray(x).view(np.uint16)
     out = codec.decode(codec.encode(view)).view(jnp.bfloat16.dtype)
     np.testing.assert_array_equal(out, np.asarray(x).view(np.uint16).view(jnp.bfloat16.dtype))
+
+
+def test_q8_quantized_round_trip_error_bound(native):
+    """Lossy int8 quantize-for-transfer: ~4x smaller payload, max abs
+    error bounded by amax/127 (half a quantization step would be
+    amax/254; rounding gives amax/127 worst case)."""
+    rng = np.random.default_rng(1)
+    arr = (rng.standard_normal((16, 128)) * 3).astype(np.float32)
+    frame = codec.encode(arr, quantize="int8")
+    lossless = codec.encode(arr)
+    assert len(frame) < 0.5 * len(lossless)
+    out = codec.decode(frame)
+    assert out.dtype == np.float32 and out.shape == arr.shape
+    step = float(np.abs(arr).max()) / 127.0
+    assert float(np.abs(out - arr).max()) <= step * (0.5 + 1e-6)
+
+
+def test_q8_edge_cases(native):
+    # All-zero input: scale falls back to 1.0, exact round trip.
+    z = np.zeros((4, 4), np.float32)
+    np.testing.assert_array_equal(codec.decode(codec.encode(z, quantize="int8")), z)
+    # Empty input.
+    e = np.zeros((0, 3), np.float32)
+    out = codec.decode(codec.encode(e, quantize="int8"))
+    assert out.shape == (0, 3) and out.dtype == np.float32
+    # Non-float input refused; unknown mode refused.
+    with pytest.raises(ValueError, match="floating"):
+        codec.encode(np.arange(4), quantize="int8")
+    with pytest.raises(ValueError, match="unknown quantize"):
+        codec.encode(z, quantize="fp4")
+
+
+def test_q8_decodes_across_backends(native, monkeypatch):
+    """A Q8 frame whose inner payload was zlib-encoded (fallback
+    backend) must decode on a native host and vice versa."""
+    arr = np.linspace(-2, 2, 64, dtype=np.float32).reshape(8, 8)
+    native_frame = codec.encode(arr, quantize="int8")
+    monkeypatch.setattr(codec, "_lib", None)
+    monkeypatch.setattr(codec, "_lib_tried", True)
+    fallback_frame = codec.encode(arr, quantize="int8")
+    out_fb = codec.decode(fallback_frame)  # fallback decodes fallback
+    monkeypatch.setattr(codec, "_lib_tried", False)
+    monkeypatch.setattr(codec, "_lib", None)
+    out_n1 = codec.decode(fallback_frame)  # native decodes fallback
+    out_n2 = codec.decode(native_frame)
+    np.testing.assert_array_equal(out_fb, out_n1)
+    np.testing.assert_allclose(out_n1, out_n2, atol=1e-7)
+
+
+def test_transport_quantize_mode(native):
+    """ArraySender(quantize='int8'): float arrays arrive quantized,
+    integer arrays arrive bit-exact."""
+    import threading
+
+    from defer_tpu.runtime.transport import ArrayReceiver, ArraySender
+
+    recv = ArrayReceiver(port=0)
+    got = []
+
+    def drain():
+        got.extend(recv)
+
+    t = threading.Thread(target=drain, daemon=True)
+    t.start()
+    snd = ArraySender("127.0.0.1", recv.port, quantize="int8")
+    f = np.linspace(-1, 1, 32, dtype=np.float32)
+    i = np.arange(32, dtype=np.int32)
+    snd.send(f)
+    snd.send(i)
+    snd.close()
+    t.join(timeout=30)
+    assert not t.is_alive() and len(got) == 2
+    assert got[0].dtype == np.float32
+    assert float(np.abs(got[0] - f).max()) <= 1.0 / 127.0
+    np.testing.assert_array_equal(got[1], i)
+
+
+def test_q8_rejects_non_finite_and_bad_sender_mode(native):
+    bad = np.array([1.0, np.inf], np.float32)
+    with pytest.raises(ValueError, match="finite"):
+        codec.encode(bad, quantize="int8")
+    with pytest.raises(ValueError, match="finite"):
+        codec.encode(np.array([np.nan], np.float32), quantize="int8")
+    from defer_tpu.runtime.transport import ArraySender
+
+    with pytest.raises(ValueError, match="unknown quantize"):
+        ArraySender("127.0.0.1", 1, quantize="int4")
