@@ -18,6 +18,7 @@ instead of sockets, and the stream loop is the async pipeline.
 
 from __future__ import annotations
 
+import collections
 import queue
 import threading
 import time
@@ -32,6 +33,7 @@ from defer_tpu.graph.partition import partition
 from defer_tpu.models import Model
 from defer_tpu.parallel.mesh import pipeline_devices
 from defer_tpu.parallel.pipeline import Pipeline
+from defer_tpu.runtime.batching import split_output
 from defer_tpu.runtime.host_io import STOP, ProgressMonitor
 from defer_tpu.utils import profiling
 from defer_tpu.utils.logging import get_logger
@@ -291,10 +293,27 @@ class DEFER:
             else Retirer(self.config.max_inflight, sync=watchdog_sync)
         )
 
+        # Dynamic batching: coalesce queue items into device batches
+        # (runtime/batching.py) and split outputs back per item.
+        # `splits` mirrors the dispatch FIFO: one sizes-list per
+        # submitted batch, popped as its output retires.
+        gatherer = None
+        splits: "collections.deque[list[int]]" = collections.deque()
+        if self.config.dynamic_batch_size > 1:
+            from defer_tpu.runtime.batching import BatchGatherer
+
+            gatherer = BatchGatherer(
+                self.config.dynamic_batch_size, self.config.batch_wait_s
+            )
+
         def emit(items: Sequence[Any]) -> None:
             for out in items:
                 monitor.completed()
-                output_stream.put(out)
+                if gatherer is None:
+                    output_stream.put(out)
+                else:
+                    for part in split_output(out, splits.popleft()):
+                        output_stream.put(part)
 
         # Unlike Pipeline.stream (pull-based), this loop must keep
         # emitting results while the input queue idles — the reference's
@@ -305,27 +324,44 @@ class DEFER:
         tracer = profiling.WindowTrace()
         try:
             self._stream_loop(
-                pipe, input_stream, emit, retirer, monitor, tracer
+                pipe, input_stream, emit, retirer, monitor, tracer,
+                gatherer, splits,
             )
         finally:
             tracer.close()
 
-    def _stream_loop(self, pipe, input_stream, emit, retirer, monitor, tracer):
+    def _stream_loop(
+        self, pipe, input_stream, emit, retirer, monitor, tracer,
+        gatherer=None, splits=None,
+    ):
         since_probe = 0
         retries_left = self.config.redispatch_attempts
-        while not self._stop.is_set():
-            try:
-                item = input_stream.get(timeout=0.05)
-            except queue.Empty:
-                emit(retirer.collect())
-                monitor.check()
-                continue
-            if item is None or item is STOP:
-                break
+        eos = False
+        while not self._stop.is_set() and not eos:
+            if gatherer is None:
+                try:
+                    item = input_stream.get(timeout=0.05)
+                except queue.Empty:
+                    emit(retirer.collect())
+                    monitor.check()
+                    continue
+                if item is None or item is STOP:
+                    break
+                sizes = None
+            else:
+                item, sizes, eos = gatherer.gather(input_stream)
+                if item is None:
+                    if eos:
+                        break
+                    emit(retirer.collect())
+                    monitor.check()
+                    continue
             monitor.submitted()
             tracer.tick()
             while True:
                 try:
+                    if sizes is not None:
+                        splits.append(sizes)
                     emit(retirer.add(pipe.submit(item)))
                     break
                 except Exception as e:  # noqa: BLE001 — recovery below
@@ -340,6 +376,10 @@ class DEFER:
                     except Exception:  # noqa: BLE001 — dead buffers
                         pass
                     lost = retirer.discard()
+                    if splits is not None:
+                        # Everything un-emitted was just discarded; the
+                        # retry below re-appends this batch's sizes.
+                        splits.clear()
                     if lost:
                         log.warning(
                             "dropping %d in-flight results of the failed "
@@ -361,6 +401,10 @@ class DEFER:
                 self.last_stage_latencies = pipe.probe_stage_latencies(
                     item, iters=3
                 )
+        # (A carried mismatch item can never survive to the sentinel:
+        # gather() prepends the carry before it can consume STOP, so a
+        # pending carry here means stop() interrupted the stream — and
+        # after an explicit stop we must not submit new device work.)
         emit(retirer.flush())
 
     def stop(self) -> None:

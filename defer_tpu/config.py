@@ -43,6 +43,15 @@ class DeferConfig:
         up to this many times, retrying the failed microbatch (elastic
         recovery; results in flight at failure time may be lost and the
         retried input re-runs from stage 0). 0 = fail fast.
+      dynamic_batch_size: during run_defer, coalesce up to this many
+        adjacent input-queue items into ONE device batch (outputs are
+        split back per item, order preserved). The reference streams
+        batch-1 frames (reference src/test.py:52-54); the TPU is ~50x
+        faster at batch 256 than batch 1, so serving loops should
+        batch. 1 disables (default).
+      batch_wait_s: with dynamic batching, how long to wait for more
+        items after a batch's first item arrives — the latency SLO the
+        batcher trades against device efficiency.
     """
 
     compute_dtype: Any = jnp.bfloat16
@@ -59,6 +68,8 @@ class DeferConfig:
     donate_activations: bool = True
     collective_timeout_s: float = 120.0
     redispatch_attempts: int = 1
+    dynamic_batch_size: int = 1
+    batch_wait_s: float = 0.005
 
     def replace(self, **kw: Any) -> "DeferConfig":
         return dataclasses.replace(self, **kw)

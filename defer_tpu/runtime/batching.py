@@ -1,0 +1,145 @@
+"""Dynamic batching for the streaming serve path.
+
+The reference streams batch-1 frames end to end (one image per queue
+item, reference src/test.py:52-54) — fine for CPUs, ruinous on a TPU:
+the measured single-chip gap is ~50x between batch-1 and batch-256
+ResNet50 throughput (bench.py sweep). This adapter coalesces adjacent
+queue items into one device batch under a latency SLO, and splits the
+batched output back into per-item results, so the reference's
+item-in/item-out queue contract survives while the MXU sees real
+batches.
+
+Enable via DeferConfig(dynamic_batch_size=N, batch_wait_s=SLO):
+`DEFER.run_defer` then gathers up to N items per dispatch, waiting at
+most `batch_wait_s` after the first item of a batch arrives.
+"""
+
+from __future__ import annotations
+
+import queue as queue_mod
+import time
+from typing import Any
+
+import jax.numpy as jnp
+
+from defer_tpu.runtime.host_io import STOP
+
+
+class BatchGatherer:
+    """Coalesce queue items (arrays with a leading batch dim) into one
+    stacked batch per dispatch.
+
+    Items with mismatched trailing shapes or dtypes are never mixed: a
+    mismatch flushes the current batch and the odd item starts the
+    next one (carried between calls).
+    """
+
+    def __init__(
+        self, batch_size: int, max_wait_s: float, *, pad_to_buckets: bool = True
+    ):
+        if batch_size < 2:
+            raise ValueError("dynamic batching needs batch_size >= 2")
+        self.batch_size = batch_size
+        self.max_wait_s = max_wait_s
+        # Pad partial batches up to the next power-of-two bucket
+        # (<= batch_size): every distinct leading dim is a fresh XLA
+        # compile of the whole stage chain, so unbucketed bursty
+        # traffic (256, 113, 41, 7, ...) would turn the ms-level SLO
+        # into multi-second compile stalls. Buckets bound the compile
+        # cache to log2(batch_size) shapes; split_output drops the pad
+        # rows by construction (sizes sum to the real total).
+        self.pad_to_buckets = pad_to_buckets
+        self._carry: Any = None
+
+    @staticmethod
+    def _compatible(a: Any, b: Any) -> bool:
+        return (
+            getattr(a, "ndim", 0) >= 1
+            and getattr(b, "ndim", 0) >= 1
+            and a.shape[1:] == b.shape[1:]
+            and a.dtype == b.dtype
+        )
+
+    def gather(
+        self, input_stream: "queue_mod.Queue[Any]", poll_s: float = 0.05
+    ) -> tuple[Any, list[int] | None, bool]:
+        """Pull one batch. Returns (batch, sizes, eos):
+
+        * batch: stacked array (or None if only the sentinel / nothing
+          arrived); sizes: per-item leading-dim sizes for the splitter.
+        * eos: the STOP/None sentinel was consumed.
+
+        Blocks at most `poll_s` for the FIRST item (so the caller's
+        idle loop keeps servicing results), then at most `max_wait_s`
+        total for the rest of the batch.
+        """
+        items: list[Any] = []
+        if self._carry is not None:
+            items.append(self._carry)
+            self._carry = None
+        eos = False
+        if not items:
+            try:
+                first = input_stream.get(timeout=poll_s)
+            except queue_mod.Empty:
+                return None, None, False
+            if first is None or first is STOP:
+                return None, None, True
+            items.append(first)
+        if getattr(items[0], "ndim", 0) < 1:
+            raise ValueError(
+                "dynamic batching requires queue items with a leading "
+                f"batch dim; got shape {getattr(items[0], 'shape', ())} — "
+                "disable dynamic_batch_size or add a batch axis"
+            )
+        deadline = time.monotonic() + self.max_wait_s
+        while len(items) < self.batch_size:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                nxt = input_stream.get(timeout=remaining)
+            except queue_mod.Empty:
+                break
+            if nxt is None or nxt is STOP:
+                eos = True
+                break
+            if not self._compatible(items[0], nxt):
+                # Flush what we have; the odd item opens the next batch.
+                self._carry = nxt
+                break
+            items.append(nxt)
+        sizes = [int(x.shape[0]) for x in items]
+        total = sum(sizes)
+        pad = 0
+        if self.pad_to_buckets and total < self.batch_size:
+            bucket = 1
+            while bucket < total:
+                bucket *= 2
+            pad = min(bucket, self.batch_size) - total
+        if pad:
+            items.append(
+                jnp.zeros((pad, *items[0].shape[1:]), items[0].dtype)
+            )
+        batch = (
+            items[0]
+            if len(items) == 1
+            else jnp.concatenate(items, axis=0)
+        )
+        return batch, sizes, eos
+
+    def pending(self) -> bool:
+        return self._carry is not None
+
+
+def split_output(out: Any, sizes: list[int]) -> list[Any]:
+    """Invert the gather: slice the batched output back into per-item
+    results (device-side slices; no host transfer)."""
+    if len(sizes) == 1:
+        return [out]
+    parts = []
+    off = 0
+    for s in sizes:
+        parts.append(out[off : off + s])
+        off += s
+    return parts

@@ -111,9 +111,20 @@ class ArraySender:
             if self.quantize and np.issubdtype(a.dtype, np.floating)
             else None
         )
-        frame = codec.encode(
-            a, level=self.level if self.compress else 0, quantize=quant
-        )
+        level = self.level if self.compress else 0
+        try:
+            frame = codec.encode(a, level=level, quantize=quant)
+        except ValueError:
+            if quant is None:
+                raise
+            # Non-finite values can't be quantized (codec refuses
+            # rather than silently corrupting); one bad tensor must
+            # not tear down the whole stream — ship it losslessly.
+            log.warning(
+                "tensor contains NaN/Inf; sending losslessly instead of "
+                "quantized"
+            )
+            frame = codec.encode(a, level=level)
         with self._lock:
             self._sock.sendall(_HEADER.pack(_TAG_ARRAY, len(frame)) + frame)
 
