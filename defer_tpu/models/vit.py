@@ -17,10 +17,29 @@ so DEFER-style cut lists, `partition_layers="auto"`, and
 
 from __future__ import annotations
 
+import dataclasses
+from typing import Any
+
+import jax
 import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
 
 from defer_tpu.graph.ir import GraphBuilder
 from defer_tpu.models import Model, register_model
+from defer_tpu.parallel.spmd_pipeline import (
+    make_spmd_pipeline,
+    stack_for_stages,
+    staged_specs,
+)
+from defer_tpu.parallel.transformer_stack import (
+    TransformerConfig,
+    _layer_norm,
+    init_stack,
+    layers_apply,
+    stack_specs,
+)
 
 
 def _build_vit(
@@ -105,6 +124,163 @@ def vit_s16(image_size: int = 224) -> Model:
         num_heads=6,
         mlp_dim=1536,
     )
+
+
+# --------------------------------------------------------------------------
+# SPMD form
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class SpmdVit:
+    """ViT on the shard_map circular pipeline (pre-LN stack).
+
+    Mesh axes (any may be size 1): "data" (batch), "stage" (pipeline),
+    "model" (tensor parallel). One jitted step runs patch-embed ->
+    S-stage ppermute pipeline -> final LN -> [class] head. The CNN-era
+    analogue is impossible in the reference (whole Keras models shipped
+    to CPU nodes); this is the TPU-native formulation of the same
+    "split a vision model over devices" capability.
+    """
+
+    mesh: Mesh
+    cfg: TransformerConfig
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    compute_dtype: Any = jnp.bfloat16
+
+    def __post_init__(self):
+        if "stage" not in self.mesh.axis_names:
+            raise ValueError("SpmdVit needs a 'stage' mesh axis")
+        if self.cfg.norm_style != "pre":
+            raise ValueError("ViT uses pre-LN: cfg.norm_style must be 'pre'")
+        if self.image_size % self.patch_size:
+            raise ValueError(
+                f"image {self.image_size} not divisible by patch "
+                f"{self.patch_size}"
+            )
+        self.num_stages = self.mesh.shape.get("stage", 1)
+        self.tp_axis = (
+            "model" if self.mesh.shape.get("model", 1) > 1 else None
+        )
+        if self.cfg.num_layers % self.num_stages:
+            raise ValueError(
+                f"{self.cfg.num_layers} layers not divisible by "
+                f"{self.num_stages} stages"
+            )
+        self.grid = self.image_size // self.patch_size
+        self.num_tokens = self.grid * self.grid + 1
+
+    def _stack_param_specs(self):
+        return staged_specs(stack_specs(None, self.tp_axis), "stage")
+
+    def init(self, rng: jax.Array) -> dict:
+        from jax.sharding import NamedSharding
+
+        cfg = self.cfg
+        kp, ks, kc, kpos, kh = jax.random.split(rng, 5)
+        stacked = jax.device_put(
+            stack_for_stages(init_stack(ks, cfg), self.num_stages),
+            jax.tree_util.tree_map(
+                lambda s: NamedSharding(self.mesh, s),
+                self._stack_param_specs(),
+                is_leaf=lambda s: isinstance(s, P),
+            ),
+        )
+        rep = NamedSharding(self.mesh, P())
+        pp, d = self.patch_size, cfg.dim
+        scale = (pp * pp * 3) ** -0.5
+        return {
+            "patch_kernel": jax.device_put(
+                jax.random.normal(kp, (pp, pp, 3, d)) * scale, rep
+            ),
+            "patch_bias": jax.device_put(jnp.zeros((d,)), rep),
+            "cls": jax.device_put(
+                jax.random.normal(kc, (1, 1, d)) * 0.02, rep
+            ),
+            "pos": jax.device_put(
+                jax.random.normal(kpos, (self.num_tokens, d)) * 0.02, rep
+            ),
+            "final_ln_scale": jax.device_put(jnp.ones((d,)), rep),
+            "final_ln_bias": jax.device_put(jnp.zeros((d,)), rep),
+            "head_w": jax.device_put(
+                jax.random.normal(kh, (d, self.num_classes)) * d**-0.5,
+                rep,
+            ),
+            "head_b": jax.device_put(jnp.zeros((self.num_classes,)), rep),
+            "stack": stacked,
+        }
+
+    def _embed(self, params: dict, images: jax.Array) -> jax.Array:
+        """[N, H, W, 3] -> [N, tokens, D] (patch conv + cls + pos)."""
+        cd = self.compute_dtype
+        x = lax.conv_general_dilated(
+            images.astype(cd),
+            params["patch_kernel"].astype(cd),
+            window_strides=(self.patch_size, self.patch_size),
+            padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        ) + params["patch_bias"].astype(cd)
+        n = x.shape[0]
+        x = x.reshape(n, self.grid * self.grid, self.cfg.dim)
+        cls = jnp.broadcast_to(
+            params["cls"].astype(cd), (n, 1, self.cfg.dim)
+        )
+        x = jnp.concatenate([cls, x], axis=1)
+        return x + params["pos"].astype(cd)
+
+    def make_step(self):
+        """Jitted (params, images [M, B, H, W, 3]) -> logits [M, B, C]."""
+        cfg = self.cfg
+
+        def stage_fn(stack_local, x):
+            return layers_apply(stack_local, x, cfg, tp_axis=self.tp_axis)
+
+        pipe = make_spmd_pipeline(
+            self.mesh,
+            stage_fn,
+            self._stack_param_specs(),
+            stage_axis="stage",
+            data_axis="data" if self.mesh.shape.get("data", 1) > 1 else None,
+        )
+
+        def step(params, images):
+            m, b = images.shape[:2]
+            emb = self._embed(
+                params, images.reshape(m * b, *images.shape[2:])
+            ).reshape(m, b, self.num_tokens, cfg.dim)
+            ys = pipe(params["stack"], emb)
+            return self._head(params, ys)
+
+        return jax.jit(step)
+
+    def _head(self, params: dict, ys: jax.Array) -> jax.Array:
+        """Final LN on the [class] token + classifier head — ONE
+        definition shared by the pipelined step and the correctness
+        reference."""
+        cd = self.compute_dtype
+        cls = _layer_norm(
+            ys[:, :, 0, :].astype(cd),
+            params["final_ln_scale"],
+            params["final_ln_bias"],
+            self.cfg.layer_norm_eps,
+        )
+        return cls @ params["head_w"].astype(cd) + params["head_b"].astype(cd)
+
+    def reference_apply(self, params: dict, images: jax.Array) -> jax.Array:
+        """Unpipelined single-program reference for correctness checks."""
+        cfg = self.cfg
+        m, b = images.shape[:2]
+        emb = self._embed(
+            params, images.reshape(m * b, *images.shape[2:])
+        ).reshape(m, b, self.num_tokens, cfg.dim)
+        flat = jax.tree_util.tree_map(
+            lambda a: jnp.asarray(a).reshape(-1, *a.shape[2:]),
+            params["stack"],
+        )
+        ys = jnp.stack([layers_apply(flat, emb[i], cfg) for i in range(m)])
+        return self._head(params, ys)
 
 
 @register_model("vit_tiny")

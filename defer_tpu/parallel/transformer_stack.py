@@ -44,6 +44,10 @@ class TransformerConfig:
     # > 0 switches every block's FFN to a top-1-routed mixture of
     # experts (expert-parallel over an "expert" mesh axis).
     num_experts: int = 0
+    # "post" = BERT-style residual-then-norm; "pre" = GPT/ViT-style
+    # norm-then-sublayer (ln params then normalize the sublayer INPUT,
+    # and the residual stream is never normalized in-block).
+    norm_style: str = "post"
 
 
 def init_stack(
@@ -210,7 +214,8 @@ def block_apply(
     sp_strategy: str = "ring",
     ep_axis: str | None = None,
 ) -> jax.Array:
-    """One post-LN encoder block on (B, S, D); params have no layer axis.
+    """One encoder block on (B, S, D) (post- or pre-LN per
+    cfg.norm_style); params have no layer axis.
 
     Under shard_map with tp_axis set, the projections arrive
     column-sharded (local output features = one head group) and wo/w2
@@ -224,10 +229,16 @@ def block_apply(
     dt = x.dtype
     tp_size = 1 if tp_axis is None else lax.axis_size(tp_axis)
     local_heads = cfg.num_heads // tp_size
+    pre = cfg.norm_style == "pre"
 
-    q = x @ p["wq"].astype(dt) + p["bq"].astype(dt)
-    k = x @ p["wk"].astype(dt) + p["bk"].astype(dt)
-    v = x @ p["wv"].astype(dt) + p["bv"].astype(dt)
+    a_in = (
+        _layer_norm(x, p["ln1_scale"], p["ln1_bias"], cfg.layer_norm_eps)
+        if pre
+        else x
+    )
+    q = a_in @ p["wq"].astype(dt) + p["bq"].astype(dt)
+    k = a_in @ p["wk"].astype(dt) + p["bk"].astype(dt)
+    v = a_in @ p["wv"].astype(dt) + p["bv"].astype(dt)
     attn = multi_head_attention(
         q,
         k,
@@ -241,19 +252,28 @@ def block_apply(
     if tp_axis is not None:
         attn = lax.psum(attn, tp_axis)
     attn = attn + p["bo"].astype(dt)
-    x = _layer_norm(
-        x + attn, p["ln1_scale"], p["ln1_bias"], cfg.layer_norm_eps
-    )
+    if pre:
+        x = x + attn
+        f_in = _layer_norm(
+            x, p["ln2_scale"], p["ln2_bias"], cfg.layer_norm_eps
+        )
+    else:
+        x = _layer_norm(
+            x + attn, p["ln1_scale"], p["ln1_bias"], cfg.layer_norm_eps
+        )
+        f_in = x
 
     if "router" in p:
-        h = moe_ffn(p, x, tp_axis=tp_axis, ep_axis=ep_axis)
+        h = moe_ffn(p, f_in, tp_axis=tp_axis, ep_axis=ep_axis)
     else:
-        h = x @ p["w1"].astype(dt) + p["b1"].astype(dt)
+        h = f_in @ p["w1"].astype(dt) + p["b1"].astype(dt)
         h = jax.nn.gelu(h)
         h = h @ p["w2"].astype(dt)
         if tp_axis is not None:
             h = lax.psum(h, tp_axis)
         h = h + p["b2"].astype(dt)
+    if pre:
+        return x + h
     return _layer_norm(x + h, p["ln2_scale"], p["ln2_bias"], cfg.layer_norm_eps)
 
 

@@ -92,8 +92,14 @@ class BatchGatherer:
                 f"batch dim; got shape {getattr(items[0], 'shape', ())} — "
                 "disable dynamic_batch_size or add a batch axis"
             )
+        # batch_size bounds ROWS (the device batch), not item count —
+        # multi-row items fill it proportionally faster. An item that
+        # would overflow the bound is carried to the next batch, so
+        # the device batch never exceeds batch_size (unless a single
+        # item is itself larger — items are atomic).
+        total = int(items[0].shape[0])
         deadline = time.monotonic() + self.max_wait_s
-        while len(items) < self.batch_size:
+        while total < self.batch_size:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 break
@@ -104,13 +110,16 @@ class BatchGatherer:
             if nxt is None or nxt is STOP:
                 eos = True
                 break
-            if not self._compatible(items[0], nxt):
+            if (
+                not self._compatible(items[0], nxt)
+                or total + int(nxt.shape[0]) > self.batch_size
+            ):
                 # Flush what we have; the odd item opens the next batch.
                 self._carry = nxt
                 break
             items.append(nxt)
+            total += int(nxt.shape[0])
         sizes = [int(x.shape[0]) for x in items]
-        total = sum(sizes)
         pad = 0
         if self.pad_to_buckets and total < self.batch_size:
             bucket = 1
@@ -134,9 +143,14 @@ class BatchGatherer:
 
 def split_output(out: Any, sizes: list[int]) -> list[Any]:
     """Invert the gather: slice the batched output back into per-item
-    results (device-side slices; no host transfer)."""
+    results (device-side slices; no host transfer). Pad rows beyond
+    sum(sizes) — bucket padding — are dropped by construction."""
     if len(sizes) == 1:
-        return [out]
+        # Only skip the slice when there was no padding: a padded
+        # single-item batch must not leak its garbage pad rows.
+        if getattr(out, "ndim", 0) >= 1 and out.shape[0] == sizes[0]:
+            return [out]
+        return [out[: sizes[0]]]
     parts = []
     off = 0
     for s in sizes:

@@ -178,3 +178,31 @@ def test_transport_quantize_non_finite_falls_back_lossless():
     t.join(timeout=30)
     assert not t.is_alive() and len(got) == 1
     onp.testing.assert_array_equal(got[0], bad)  # lossless, NaN/Inf kept
+
+
+def test_single_padded_item_does_not_leak_pad_rows():
+    """A lone (3, C) item padded to the 4-bucket must come back as
+    (3, C) — pad rows are garbage, not results."""
+    q: "queue.Queue" = queue.Queue()
+    q.put(jnp.ones((3, 8)))
+    g = BatchGatherer(batch_size=64, max_wait_s=0.05)
+    batch, sizes, _ = g.gather(q)
+    assert batch.shape == (4, 8) and sizes == [3]
+    parts = split_output(batch, sizes)
+    assert len(parts) == 1 and parts[0].shape == (3, 8)
+
+
+def test_gather_bounds_rows_not_item_count():
+    """batch_size caps device ROWS: (3, C) items with batch_size=8 stop
+    at 2 items (6 rows; a third would overflow) and the overflow item
+    carries to the next batch."""
+    q: "queue.Queue" = queue.Queue()
+    for _ in range(3):
+        q.put(jnp.ones((3, 8)))
+    g = BatchGatherer(batch_size=8, max_wait_s=1.0)
+    b1, s1, _ = g.gather(q)
+    assert s1 == [3, 3]
+    assert b1.shape == (8, 8)  # 6 rows padded to the 8 bucket
+    assert g.pending()
+    b2, s2, _ = g.gather(q)
+    assert s2 == [3] and b2.shape == (4, 8)
