@@ -1,0 +1,210 @@
+"""Remote stage worker: the reference's compute node, as a process.
+
+The reference's deployment unit is `python node.py` on another machine:
+it receives architecture JSON (port 5001), weights (port 5002), its
+successor's address, then relays activations (port 5000) through
+`model.predict` forever (reference src/node.py:135-152). This module is
+that capability for the native IR over the DCN transport seam — ONE
+stream carries the whole session:
+
+    frame 1      uint8 bytes of the stage's graph JSON
+                 (defer_tpu/graph/serialize.py)
+    frame 2      uint8 bytes of the param manifest (JSON list of
+                 'node/param' paths)
+    frames 3..   one array per manifest entry (the weights wire,
+                 reference src/dispatcher.py:75-88)
+    then         activation frames — len(input_names) frames per
+                 microbatch for bundle boundaries; results stream to
+                 the --next peer as len(output_names) frames
+    STOP         ends the session (the shutdown the reference lacks)
+
+Worker CLI (the `node.py` analogue; chain wiring via --next replaces
+the reference's nextNode message, src/dispatcher.py:54-58):
+
+    python -m defer_tpu.runtime.remote_stage --listen 0 \
+        --next 10.0.0.2:5000
+
+Dispatcher side: `dispatch_stage(sender, stage, params)` then
+`send_activation(sender, x)` per microbatch.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+import numpy as np
+
+from defer_tpu.graph.serialize import (
+    frames_to_params,
+    graph_from_json,
+    graph_to_json,
+    params_to_frames,
+)
+from defer_tpu.runtime.transport import ArrayReceiver, ArraySender
+from defer_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+def _num_inputs(stage: Any) -> int:
+    return len(getattr(stage, "input_names", ("x",)))
+
+
+def _num_outputs(stage: Any) -> int:
+    return len(getattr(stage, "output_names", ("y",)))
+
+
+def _send_blob(sender: ArraySender, data: bytes) -> None:
+    sender.send(np.frombuffer(data, np.uint8))
+
+
+def dispatch_stage(sender: ArraySender, stage: Any, params: Any) -> None:
+    """Ship a stage (architecture + weights) to a worker — the
+    reference's `_dispatchModels` for one node (src/dispatcher.py:47-73).
+
+    Weights always go LOSSLESS: a sender's quantize mode is an
+    activation-transfer optimization; int8-roundtripping parameters
+    would silently skew every result the worker ever produces."""
+    saved_quant = sender.quantize
+    sender.quantize = None
+    try:
+        _send_blob(sender, graph_to_json(stage).encode())
+        pairs = params_to_frames(params)
+        _send_blob(sender, json.dumps([p for p, _ in pairs]).encode())
+        for _, arr in pairs:
+            sender.send(np.asarray(arr))
+    finally:
+        sender.quantize = saved_quant
+
+
+def send_activation(sender: ArraySender, x: Any) -> None:
+    """One microbatch: a single array, or a tuple for bundle cuts."""
+    xs = x if isinstance(x, (tuple, list)) else (x,)
+    for t in xs:
+        sender.send(np.asarray(t))
+
+
+def _read_bundle(it, n: int):
+    """Read one microbatch's n frames; None at a clean stream end,
+    RuntimeError if the stream dies mid-bundle."""
+    frames = []
+    for i in range(n):
+        try:
+            frames.append(next(it))
+        except StopIteration:
+            if i:
+                raise RuntimeError(
+                    "stream ended mid-microbatch (partial bundle)"
+                ) from None
+            return None
+    return tuple(frames)
+
+
+def recv_results(
+    receiver: ArrayReceiver, num_outputs: int = 1
+):
+    """Iterate per-microbatch results arriving from the chain's last
+    worker (the reference's `_result_server`, src/dispatcher.py:105-118).
+    Yields arrays, or tuples when the final boundary is a bundle."""
+    it = iter(receiver)
+    while True:
+        outs = _read_bundle(it, num_outputs)
+        if outs is None:
+            return
+        yield outs if num_outputs > 1 else outs[0]
+
+
+def serve_stage(
+    listen_port: int,
+    next_host: str,
+    next_port: int,
+    *,
+    listen_host: str = "0.0.0.0",
+    accept_timeout_s: float = 120.0,
+    announce=None,
+) -> int:
+    """Run one worker session to completion; returns microbatches
+    relayed. `announce(port)` is called once the listen socket is bound
+    (drivers/tests use it to learn an ephemeral port)."""
+    import jax
+
+    recv = ArrayReceiver(
+        listen_port, host=listen_host, accept_timeout_s=accept_timeout_s
+    )
+    if announce is not None:
+        announce(recv.port)
+    it = iter(recv)
+    try:
+        stage = graph_from_json(bytes(bytearray(next(it))).decode())
+        manifest = json.loads(bytes(bytearray(next(it))).decode())
+        # Explicit loop, not a generator fed to frames_to_params: a
+        # StopIteration inside a generator becomes PEP 479's opaque
+        # RuntimeError and would never reach the except below.
+        pairs = [(path, next(it)) for path in manifest]
+    except StopIteration:
+        raise RuntimeError(
+            "peer closed before the stage was fully dispatched"
+        ) from None
+    params = frames_to_params(pairs)
+    n_in, n_out = _num_inputs(stage), _num_outputs(stage)
+    fn = jax.jit(stage.apply)
+    log.info(
+        "remote stage %r ready (%d params, %d->%d tensors); relaying to "
+        "%s:%d",
+        stage.name,
+        len(manifest),
+        n_in,
+        n_out,
+        next_host,
+        next_port,
+    )
+    sender = ArraySender(next_host, next_port)
+    count = 0
+    try:
+        while True:
+            acts = _read_bundle(it, n_in)
+            if acts is None:
+                return count
+            out = fn(params, acts if n_in > 1 else acts[0])
+            outs = out if isinstance(out, tuple) else (out,)
+            for t in outs:
+                sender.send(np.asarray(t))
+            count += 1
+    finally:
+        sender.close()
+        recv.close()
+
+
+def main(argv: list[str] | None = None) -> None:
+    import argparse
+    import os
+
+    # Honor an explicit platform choice even when site customization
+    # pre-imported jax with another backend registered (same pattern
+    # as bench.py / tests/conftest.py).
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--listen", type=int, default=5000)
+    ap.add_argument(
+        "--next", required=True, help="host:port of the next chain hop"
+    )
+    ap.add_argument("--accept-timeout", type=float, default=120.0)
+    args = ap.parse_args(argv)
+    host, _, port = args.next.rpartition(":")
+    n = serve_stage(
+        args.listen,
+        host or "127.0.0.1",
+        int(port),
+        accept_timeout_s=args.accept_timeout,
+        announce=lambda p: print(f"LISTENING {p}", flush=True),
+    )
+    print(f"DONE {n}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -73,23 +73,26 @@ def run(model, params, cuts, cfg, seconds: float) -> dict:
     drainer = threading.Thread(target=drain, daemon=True)
     drainer.start()
 
-    t0 = time.perf_counter()
-    while time.perf_counter() - t0 < seconds:
-        t_sent.append(time.perf_counter())
+    def guarded_put(item) -> None:
         # Bounded put + liveness check: if the worker died (bad cuts,
         # device failure past the retry budget) the feed must error
         # out, not deadlock on a full queue forever.
         while True:
             try:
-                inq.put(x, timeout=1.0)
-                break
+                inq.put(item, timeout=1.0)
+                return
             except queue.Full:
                 if not worker.is_alive():
                     raise RuntimeError(
                         "pipeline worker died; see its traceback above"
                     ) from None
+
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < seconds:
+        t_sent.append(time.perf_counter())
+        guarded_put(x)
         sent += 1
-    inq.put(None)
+    guarded_put(None)
     worker.join(timeout=600)
     clean = not worker.is_alive()
     done.set()

@@ -1,0 +1,218 @@
+"""IR wire serialization + the remote stage worker (the reference's
+ship-a-submodel-to-another-process deployment, reference
+src/dispatcher.py:47-88 / src/node.py:135-152)."""
+
+import json
+import subprocess
+import sys
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from defer_tpu.graph.ir import GraphError
+from defer_tpu.graph.partition import partition, stage_params
+from defer_tpu.graph.serialize import (
+    frames_to_params,
+    graph_from_json,
+    graph_to_json,
+    params_to_frames,
+)
+from defer_tpu.models import get_model
+from tests.test_partition import residual_chain
+
+
+def test_graph_json_round_trip_resnet50():
+    g = get_model("resnet50").graph
+    g2 = graph_from_json(graph_to_json(g))
+    assert g2.name == g.name
+    assert g2.input_name == g.input_name
+    assert g2.output_name == g.output_name
+    assert len(g2.nodes) == len(g.nodes)
+    for a, b in zip(g.nodes, g2.nodes):
+        assert (a.name, a.op, a.inputs) == (b.name, b.op, b.inputs)
+        assert dict(a.attrs) == dict(b.attrs)
+
+
+def test_graph_json_round_trip_applies_identically():
+    g = residual_chain()
+    params = g.init(jax.random.key(0), (2, 8))
+    x = jax.random.normal(jax.random.key(1), (2, 8))
+    g2 = graph_from_json(graph_to_json(g))
+    np.testing.assert_allclose(
+        np.asarray(g2.apply(params, x)),
+        np.asarray(g.apply(params, x)),
+        rtol=1e-6,
+    )
+
+
+def test_stage_graph_round_trip_with_bundles():
+    from defer_tpu.graph.ir import GraphBuilder
+
+    gb = GraphBuilder("skip")
+    v = gb.input()
+    h_prev = gb.add("dense", v, name="h0", features=16)
+    h = gb.add("dense", h_prev, name="h1", features=16)
+    for i in range(2, 5):
+        nxt = gb.add("add", h, h_prev, name=f"mix{i}")
+        nxt = gb.add("dense", nxt, name=f"h{i}", features=16)
+        h_prev, h = h, nxt
+    g = gb.build(gb.add("dense", h, name="head", features=4))
+    stages = partition(g, [("h2", "h1")])
+    st1 = stages[1]
+    st1b = graph_from_json(graph_to_json(st1))
+    assert st1b.input_names == st1.input_names
+    assert st1b.output_names == st1.output_names
+    params = g.init(jax.random.key(0), (2, 16))
+    sp = stage_params(params, st1)
+    acts = (jnp.ones((2, 16)), jnp.ones((2, 16)) * 2)
+    np.testing.assert_allclose(
+        np.asarray(st1b.apply(sp, acts)),
+        np.asarray(st1.apply(sp, acts)),
+        rtol=1e-6,
+    )
+
+
+def test_params_frames_round_trip():
+    g = residual_chain()
+    params = g.init(jax.random.key(0), (2, 8))
+    pairs = params_to_frames(params)
+    back = frames_to_params(pairs)
+    # Parameterless nodes need no wire frames (apply uses
+    # params.get(name, {})); every parameterized node round-trips.
+    want = {k: dict(v) for k, v in params.items() if v}
+    assert jax.tree_util.tree_structure(back) == jax.tree_util.tree_structure(
+        want
+    )
+    for (p1, a1), (p2, a2) in zip(pairs, params_to_frames(back)):
+        assert p1 == p2
+        np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+    x = jax.random.normal(jax.random.key(1), (2, 8))
+    np.testing.assert_allclose(
+        np.asarray(g.apply(back, x)), np.asarray(g.apply(params, x)),
+        rtol=1e-6,
+    )
+
+
+def test_graph_json_rejects_malformed():
+    with pytest.raises(GraphError, match="not a graph"):
+        graph_from_json("{]")
+    with pytest.raises(GraphError, match="not a graph"):
+        graph_from_json(json.dumps({"no": "nodes"}))
+    with pytest.raises(GraphError, match="wire version"):
+        graph_from_json(
+            json.dumps({"wire_version": 99, "nodes": [], "name": "x"})
+        )
+    doc = json.loads(graph_to_json(residual_chain()))
+    del doc["nodes"][0]["op"]
+    with pytest.raises(GraphError, match="malformed"):
+        graph_from_json(json.dumps(doc))
+
+
+def test_two_process_pipeline_over_the_wire():
+    """The reference's deployment, end to end across OS processes:
+    parent partitions, ships stage 1 (JSON + weights) to a child
+    process, streams activations, and collects relayed results equal to
+    the single-program forward."""
+    from defer_tpu.runtime.remote_stage import (
+        dispatch_stage,
+        recv_results,
+        send_activation,
+    )
+    from defer_tpu.runtime.transport import ArrayReceiver, ArraySender
+
+    g = residual_chain()
+    params = g.init(jax.random.key(0), (2, 8))
+    st0, st1 = partition(g, ["add_1"])
+
+    results = ArrayReceiver(0, host="127.0.0.1", accept_timeout_s=60.0)
+    child = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "defer_tpu.runtime.remote_stage",
+            "--listen",
+            "0",
+            "--next",
+            f"127.0.0.1:{results.port}",
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        env={
+            **__import__("os").environ,
+            "JAX_PLATFORMS": "cpu",
+        },
+    )
+    try:
+        line = child.stdout.readline()
+        assert line.startswith("LISTENING "), (line, child.stderr.read())
+        port = int(line.split()[1])
+
+        send = ArraySender("127.0.0.1", port)
+        dispatch_stage(send, st1, stage_params(params, st1))
+
+        got = []
+        t = threading.Thread(
+            target=lambda: got.extend(recv_results(results)), daemon=True
+        )
+        t.start()
+
+        n = 5
+        p0 = stage_params(params, st0)
+        xs = [
+            np.random.default_rng(i).standard_normal((2, 8)).astype(
+                np.float32
+            )
+            for i in range(n)
+        ]
+        for x in xs:
+            send_activation(send, st0.apply(p0, x))
+        send.close()
+        t.join(timeout=120)
+        assert not t.is_alive() and len(got) == n
+        for x, out in zip(xs, got):
+            np.testing.assert_allclose(
+                out, np.asarray(g.apply(params, x)), rtol=1e-4, atol=1e-6
+            )
+        assert child.wait(timeout=60) == 0
+        assert "DONE 5" in child.stdout.read() + line
+    finally:
+        child.kill()
+        results.close()
+
+
+def test_dispatch_stage_forces_lossless_weights():
+    """A sender in int8 activation-quantize mode must NOT quantize the
+    weights it dispatches."""
+    from defer_tpu.runtime.remote_stage import dispatch_stage
+    from defer_tpu.runtime.transport import ArrayReceiver, ArraySender
+
+    g = residual_chain()
+    params = g.init(jax.random.key(0), (2, 8))
+    st0, _ = partition(g, ["add_1"])
+    sp = stage_params(params, st0)
+
+    recv = ArrayReceiver(0, host="127.0.0.1", accept_timeout_s=30.0)
+    got = []
+    t = threading.Thread(target=lambda: got.extend(recv), daemon=True)
+    t.start()
+    snd = ArraySender("127.0.0.1", recv.port, quantize="int8")
+    dispatch_stage(snd, st0, sp)
+    assert snd.quantize == "int8"  # mode restored after dispatch
+    snd.close()
+    t.join(timeout=30)
+    assert not t.is_alive()
+    from defer_tpu.graph.serialize import params_to_frames
+
+    pairs = params_to_frames(sp)
+    weight_frames = got[2 : 2 + len(pairs)]
+    for (_, want), arr in zip(pairs, weight_frames):
+        np.testing.assert_array_equal(np.asarray(arr), np.asarray(want))
+
+
+def test_params_frames_reject_slash_in_param_name():
+    with pytest.raises(GraphError, match="'/'"):
+        params_to_frames({"node": {"a/b": np.zeros(2)}})
