@@ -322,6 +322,30 @@ def run_bench() -> dict:
 
     flops_per_image = graph_flops(model.graph, params, (1, 224, 224, 3))
     chip_peak = peak_flops(topo["device_kind"])
+    try:
+        # Analytic roofline triage (host-side only, no device work):
+        # says WHY the MFU number is what it is. Byte accounting must
+        # match the pipeline's actual dtypes (bf16 activations AND
+        # params) or intensity is off 2x against the bf16 peak.
+        from defer_tpu.parallel.pipeline import cast_params_to_storage
+        from defer_tpu.utils.roofline import format_report, roofline_report
+
+        log(
+            format_report(
+                roofline_report(
+                    model.graph,
+                    cast_params_to_storage(
+                        params, DeferConfig(compute_dtype=jnp.bfloat16)
+                    ),
+                    (128, 224, 224, 3),
+                    topo["device_kind"],
+                    input_dtype=jnp.bfloat16,
+                    top=4,
+                )
+            )
+        )
+    except Exception as e:  # noqa: BLE001 — diagnostics only
+        log(f"roofline report failed ({type(e).__name__}: {e})")
     # The pipeline spans every device — achieved FLOP/s is aggregate,
     # so MFU divides by the aggregate peak.
     peak = chip_peak * max(n_dev, 1) if chip_peak else None

@@ -29,14 +29,23 @@ _PEAK_BF16: tuple[tuple[str, float], ...] = (
 )
 
 
-def peak_flops(device_kind: str) -> float | None:
-    """Dense bf16 peak FLOP/s for a TPU device kind; None if unknown
-    (e.g. the CPU backend — MFU is then not reported)."""
+def lookup_device_table(
+    device_kind: str, table: tuple[tuple[str, float], ...]
+) -> float | None:
+    """First (substring, value) match for a device kind — the one
+    lookup shared by the peak-FLOPs and peak-bandwidth tables (order
+    matters: more specific keys like 'v4 lite' come before 'v4')."""
     kind = device_kind.lower()
-    for key, val in _PEAK_BF16:
+    for key, val in table:
         if key in kind:
             return val
     return None
+
+
+def peak_flops(device_kind: str) -> float | None:
+    """Dense bf16 peak FLOP/s for a TPU device kind; None if unknown
+    (e.g. the CPU backend — MFU is then not reported)."""
+    return lookup_device_table(device_kind, _PEAK_BF16)
 
 
 # Parameters that act as one side of a contraction: FLOPs = 2 x
@@ -86,16 +95,20 @@ def flops_by_node(
     params: GraphParams,
     input_shape: Sequence[int],
     input_dtype: Any = None,
+    *,
+    specs: Any = None,
 ) -> dict[str, float]:
     """Per-node forward FLOPs for one input of `input_shape` (batch dim
-    included), from the IR's single source of shape truth."""
+    included), from the IR's single source of shape truth. `specs`
+    short-circuits shape inference when the caller already ran it."""
     import jax.numpy as jnp
 
-    specs = graph.infer_shapes(
-        params,
-        input_shape,
-        dtype=jnp.float32 if input_dtype is None else input_dtype,
-    )
+    if specs is None:
+        specs = graph.infer_shapes(
+            params,
+            input_shape,
+            dtype=jnp.float32 if input_dtype is None else input_dtype,
+        )
     return {
         node.name: node_flops(
             node.op, params.get(node.name, {}), specs[node.name].shape

@@ -1,0 +1,274 @@
+"""Roofline analysis: per-node arithmetic intensity vs the chip.
+
+MFU says how much of the MXU a model uses; it cannot say WHY the rest
+is idle. The roofline model does: each op's arithmetic intensity
+(FLOPs per HBM byte moved) against the chip's ridge point
+(peak FLOPs / peak bandwidth) classifies it compute-bound (more
+intensity than the ridge — the MXU is the limit) or memory-bound (HBM
+traffic is the limit, more FLOPs are free). The reference has no
+performance analysis at all (throughput-by-wall-clock only, reference
+src/test.py:33-41); this is the analysis tool its users would need
+next.
+
+Byte accounting has two modes. The unfused mode is the streaming
+bound per node in isolation: read every input activation once, read
+params once, write the output once. That over-counts what XLA actually
+executes — elementwise chains (BN, activations, residual adds, pads)
+fuse into their producer's epilogue and never round-trip HBM — so the
+default `assume_fusion=True` mode folds fusible ops: a fusible op's
+first input arrives in registers from its producer (not read), and its
+output is only written when a non-fusible consumer needs it. Neither
+mode is a simulator; both are triage signals.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+from defer_tpu.graph.ir import Graph, GraphParams
+from defer_tpu.utils.flops import (
+    flops_by_node,
+    lookup_device_table,
+    peak_flops,
+)
+
+# Public peak HBM bandwidth figures by device kind, bytes/sec. Order
+# matters: specific keys ('v4 lite') before generic ('v4'), mirroring
+# flops._PEAK_BF16.
+_PEAK_BW: tuple[tuple[str, float], ...] = (
+    ("v5 lite", 819e9),  # v5e
+    ("v5e", 819e9),
+    ("v5p", 2765e9),
+    ("v6 lite", 1640e9),  # Trillium
+    ("v6e", 1640e9),
+    ("v4 lite", 614e9),  # v4i
+    ("v4", 1228e9),
+    ("v3", 900e9),
+    ("v2", 700e9),
+)
+
+
+def peak_bandwidth(device_kind: str) -> float | None:
+    return lookup_device_table(device_kind, _PEAK_BW)
+
+
+# Ops XLA fuses into a producer's epilogue (elementwise / data
+# movement): their primary input never round-trips HBM.
+_FUSIBLE = frozenset(
+    {
+        "relu",
+        "relu6",
+        "sigmoid",
+        "tanh",
+        "swish",
+        "gelu",
+        "softmax",
+        "batch_norm",
+        "scale",
+        "rescale",
+        "normalization",
+        "identity",
+        "dropout",
+        "zero_pad",
+        "add",
+        "multiply",
+    }
+)
+
+
+def bytes_by_node(
+    graph: Graph,
+    params: GraphParams,
+    input_shape: Sequence[int],
+    input_dtype: Any = None,
+    *,
+    assume_fusion: bool = True,
+    specs: dict | None = None,
+) -> dict[str, float]:
+    """Per-node HBM bytes from the IR's inferred shapes.
+
+    assume_fusion=False: each node in isolation (inputs + params read,
+    output written). assume_fusion=True (default): fusible elementwise
+    ops receive their FIRST input in registers and only write their
+    output if some consumer is non-fusible (or it is the graph output)
+    — the XLA epilogue-fusion picture. `specs` short-circuits shape
+    inference when the caller already ran it."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    if specs is None:
+        specs = graph.infer_shapes(
+            params,
+            input_shape,
+            dtype=jnp.float32 if input_dtype is None else input_dtype,
+        )
+    node_map = graph.node_map
+    consumers = graph.consumers()
+    out_name = getattr(graph, "output_name", None)
+    out_names = set(getattr(graph, "output_names", ()))
+    if out_name is not None:
+        out_names.add(out_name)
+
+    def nbytes(spec) -> float:
+        return float(np.prod(spec.shape)) * spec.dtype.itemsize
+
+    out: dict[str, float] = {}
+    for node in graph.nodes:
+        if node.op == "input":
+            out[node.name] = 0.0
+            continue
+        fused = assume_fusion and node.op in _FUSIBLE
+        total = 0.0
+        # Output write: always for non-fused; for fused only when a
+        # non-fusible consumer (or the graph output) materializes it.
+        if not fused:
+            total += nbytes(specs[node.name])
+        else:
+            cons = consumers.get(node.name, [])
+            # A consumer keeps this value in registers only when it is
+            # itself fusible AND takes it as its first input.
+            needs_write = node.name in out_names or any(
+                node_map[c].op not in _FUSIBLE
+                or node_map[c].inputs[0] != node.name
+                for c in cons
+            )
+            if needs_write:
+                total += nbytes(specs[node.name])
+        for i, inp in enumerate(node.inputs):
+            if fused and i == 0 and node_map[inp].op != "input":
+                # Arrives in registers from a computing producer; the
+                # graph INPUT has no producer — it always streams from
+                # HBM and must be counted.
+                continue
+            total += nbytes(specs[inp])
+        for arr in params.get(node.name, {}).values():
+            total += float(arr.size) * arr.dtype.itemsize
+        out[node.name] = total
+    return out
+
+
+def roofline_report(
+    graph: Graph,
+    params: GraphParams,
+    input_shape: Sequence[int],
+    device_kind: str,
+    *,
+    input_dtype: Any = None,
+    top: int = 8,
+    assume_fusion: bool = True,
+) -> dict:
+    """Classify every node against the chip's ridge point.
+
+    Returns a dict with totals, the predicted time lower bound per
+    node (max of compute time and memory time — the roofline), the
+    model-level bound, and the `top` heaviest nodes by predicted time.
+    """
+    import jax.numpy as jnp
+
+    specs = graph.infer_shapes(
+        params,
+        input_shape,
+        dtype=jnp.float32 if input_dtype is None else input_dtype,
+    )
+    flops = flops_by_node(graph, params, input_shape, specs=specs)
+    bts = bytes_by_node(
+        graph,
+        params,
+        input_shape,
+        assume_fusion=assume_fusion,
+        specs=specs,
+    )
+    pf = peak_flops(device_kind)
+    bw = peak_bandwidth(device_kind)
+    ridge = (pf / bw) if pf and bw else None
+
+    nodes = []
+    for node in graph.nodes:
+        if node.op == "input":
+            continue
+        f, b = flops[node.name], bts[node.name]
+        intensity = f / b if b else float("inf")
+        entry = {
+            "name": node.name,
+            "op": node.op,
+            "flops": f,
+            "bytes": b,
+            "intensity": round(intensity, 2),
+        }
+        if ridge is not None:
+            t_compute = f / pf
+            t_memory = b / bw
+            entry["bound"] = (
+                "compute" if t_compute >= t_memory else "memory"
+            )
+            entry["t_lower_s"] = max(t_compute, t_memory)
+        nodes.append(entry)
+
+    report: dict = {
+        "device_kind": device_kind,
+        "peak_flops": pf,
+        "peak_bandwidth": bw,
+        "ridge_intensity": round(ridge, 1) if ridge is not None else None,
+        "total_flops": sum(flops.values()),
+        "total_bytes": sum(bts.values()),
+    }
+    if ridge is not None:
+        t_total = sum(e["t_lower_s"] for e in nodes)
+        by_bound = {"compute": 0.0, "memory": 0.0}
+        for e in nodes:
+            by_bound[e["bound"]] += e["t_lower_s"]
+        report.update(
+            {
+                "t_lower_s": t_total,
+                # Throughput AT this traffic model's bound — not a hard
+                # ceiling: real XLA fusion (VMEM reuse across non-
+                # elementwise ops, conv input re-use) can move fewer
+                # bytes than the model and measure faster.
+                "items_per_sec_at_bound": (
+                    input_shape[0] / t_total if t_total else None
+                ),
+                "time_share": {
+                    k: round(v / t_total, 3) if t_total else None
+                    for k, v in by_bound.items()
+                },
+                "top_nodes": sorted(
+                    nodes, key=lambda e: -e["t_lower_s"]
+                )[:top],
+            }
+        )
+    else:
+        report["top_nodes"] = sorted(nodes, key=lambda e: -e["flops"])[:top]
+    return report
+
+
+def format_report(report: dict) -> str:
+    """Human-readable summary of roofline_report."""
+    lines = [
+        f"roofline[{report['device_kind']}]: "
+        f"{report['total_flops'] / 1e9:.2f} GFLOP, "
+        f"{report['total_bytes'] / 1e6:.1f} MB moved"
+        + (
+            f", ridge {report['ridge_intensity']} FLOP/B"
+            if report.get("ridge_intensity")
+            else ""
+        )
+    ]
+    if "t_lower_s" in report:
+        share = report["time_share"]
+        lines.append(
+            f"  bound: {share['compute']:.0%} compute / "
+            f"{share['memory']:.0%} memory; "
+            f"{report['items_per_sec_at_bound']:.0f} items/s at the "
+            "traffic-model bound"
+        )
+    for e in report["top_nodes"]:
+        t = (
+            f" {e['t_lower_s'] * 1e6:.0f}us ({e['bound']})"
+            if "t_lower_s" in e
+            else ""
+        )
+        lines.append(
+            f"  {e['name']:<28} {e['op']:<16} "
+            f"{e['flops'] / 1e6:>9.1f} MFLOP {e['intensity']:>8.1f} F/B{t}"
+        )
+    return "\n".join(lines)

@@ -1,0 +1,107 @@
+"""Roofline analyzer: byte accounting, classification, report shape."""
+
+import jax
+import numpy as np
+
+from defer_tpu.models import get_model
+from defer_tpu.utils.flops import flops_by_node
+from defer_tpu.utils.roofline import (
+    bytes_by_node,
+    format_report,
+    peak_bandwidth,
+    roofline_report,
+)
+
+
+def test_peak_bandwidth_table():
+    assert peak_bandwidth("TPU v5 lite") == 819e9
+    assert peak_bandwidth("TPU v4") == 1228e9
+    assert peak_bandwidth("TFRT_CPU") is None
+
+
+def test_bytes_by_node_dense():
+    from tests.test_partition import residual_chain
+
+    g = residual_chain()
+    params = g.init(jax.random.key(0), (4, 8))
+    b = bytes_by_node(g, params, (4, 8))
+    # dense h0: read (4,8) in + (8,8) kernel + (8,) bias, write (4,8),
+    # all fp32.
+    d0 = next(n for n in g.nodes if n.op == "dense").name
+    want = 4 * (4 * 8 + 8 * 8 + 8 + 4 * 8)
+    assert b[d0] == want
+
+
+def test_resnet50_classification_large_batch():
+    """At batch 128 the big convs are compute-bound on v5e, the
+    elementwise/BN tail is memory-bound, and the aggregate report
+    carries both shares."""
+    model = get_model("resnet50")
+    params = model.graph.init(jax.random.key(0), (1, 64, 64, 3))
+    rep = roofline_report(
+        model.graph, params, (128, 64, 64, 3), "TPU v5 lite"
+    )
+    assert rep["ridge_intensity"] == round(197e12 / 819e9, 1)
+    assert all("bound" in e for e in rep["top_nodes"])
+    # Both regimes present: heavy convs contribute compute time, the
+    # elementwise/BN tail contributes memory time.
+    assert 0.0 < rep["time_share"]["compute"] < 1.0
+    assert 0.0 < rep["time_share"]["memory"] < 1.0
+    assert rep["items_per_sec_at_bound"] > 0
+    # Totals agree with the flops module.
+    f = flops_by_node(model.graph, params, (128, 64, 64, 3))
+    assert rep["total_flops"] == sum(f.values())
+
+
+def test_relu_is_memory_bound():
+    """An elementwise op can never beat the ridge point."""
+    from defer_tpu.graph.ir import GraphBuilder
+
+    b = GraphBuilder("ew")
+    x = b.input()
+    g = b.build(b.add("relu", x, name="r"))
+    params = g.init(jax.random.key(0), (1024, 1024))
+    rep = roofline_report(g, params, (1024, 1024), "TPU v5 lite")
+    (entry,) = rep["top_nodes"]
+    assert entry["bound"] == "memory"
+    assert entry["intensity"] < rep["ridge_intensity"]
+
+
+def test_format_report_runs():
+    model = get_model("vit_tiny")
+    params = model.graph.init(jax.random.key(0), (1, 32, 32, 3))
+    rep = roofline_report(
+        model.graph, params, (8, 32, 32, 3), "TPU v5 lite", top=4
+    )
+    text = format_report(rep)
+    assert "roofline[TPU v5 lite]" in text and "bound:" in text
+    # Unknown device: no ridge, still produces a report.
+    rep2 = roofline_report(
+        model.graph, params, (8, 32, 32, 3), "TFRT_CPU", top=4
+    )
+    assert rep2["ridge_intensity"] is None
+    assert "top_nodes" in rep2 and format_report(rep2)
+
+
+def test_fusion_folds_elementwise_tail():
+    """conv -> bn -> relu: with fusion the bn/relu cost ~param bytes
+    only, and total bytes drop well below the unfused accounting."""
+    from defer_tpu.graph.ir import GraphBuilder
+
+    b = GraphBuilder("cbr")
+    x = b.input()
+    h = b.add("conv", x, name="c", features=64, kernel_size=(3, 3))
+    h = b.add("batch_norm", h, name="bn")
+    g = b.build(b.add("relu", h, name="r"))
+    params = g.init(jax.random.key(0), (8, 32, 32, 16))
+    fused = bytes_by_node(g, params, (8, 32, 32, 16))
+    unfused = bytes_by_node(
+        g, params, (8, 32, 32, 16), assume_fusion=False
+    )
+    act = 8 * 32 * 32 * 64 * 4
+    # bn: no activation read (registers), no write (relu consumes it
+    # fused), only its 4 per-channel param vectors.
+    assert fused["bn"] == 4 * 64 * 4
+    # relu is the graph output: write only.
+    assert fused["r"] == act
+    assert sum(fused.values()) < 0.5 * sum(unfused.values())
