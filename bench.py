@@ -280,14 +280,9 @@ def bench_bert(devices) -> dict:
 def run_bench() -> dict:
     import jax
 
-    # Honor an explicit platform choice. The env default alone is not
-    # enough here: this machine's site customization pre-imports jax
-    # and forces its platform via config.update, which overrides the
-    # env-derived default — so we override back, before first backend
-    # use. (Verified empirically: without this, JAX_PLATFORMS=cpu runs
-    # still initialized the site platform.)
-    if os.environ.get("JAX_PLATFORMS"):
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    from defer_tpu.utils.platform import honor_env_platform
+
+    honor_env_platform()
     import jax.numpy as jnp
 
     from defer_tpu.config import DeferConfig

@@ -178,15 +178,10 @@ def serve_stage(
 
 def main(argv: list[str] | None = None) -> None:
     import argparse
-    import os
 
-    # Honor an explicit platform choice even when site customization
-    # pre-imported jax with another backend registered (same pattern
-    # as bench.py / tests/conftest.py).
-    if os.environ.get("JAX_PLATFORMS"):
-        import jax
+    from defer_tpu.utils.platform import honor_env_platform
 
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    honor_env_platform()
 
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--listen", type=int, default=5000)

@@ -1,0 +1,22 @@
+"""One place for the JAX platform-selection workaround.
+
+The env default alone is not enough on hosts whose site customization
+pre-imports jax and forces its platform via config.update, which
+overrides the env-derived default — so we override back, before first
+backend use. (Verified empirically: without this, JAX_PLATFORMS=cpu
+runs still initialized the site platform.)
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_env_platform() -> None:
+    """Apply $JAX_PLATFORMS to the live jax config if set. Call before
+    first backend use in every entry point (bench, CLI, workers)."""
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        import jax
+
+        jax.config.update("jax_platforms", want)

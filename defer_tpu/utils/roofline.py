@@ -64,6 +64,7 @@ _FUSIBLE = frozenset(
         "gelu",
         "softmax",
         "batch_norm",
+        "layer_norm",
         "scale",
         "rescale",
         "normalization",

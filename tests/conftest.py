@@ -37,6 +37,26 @@ def devices():
     return devs
 
 
+FLAKY = {"failures": 0}
+
+
+def register_flaky_op() -> None:
+    """Idempotently register the 'flaky' fault-injection op: raises
+    while FLAKY['failures'] > 0 (decrementing), else identity. Shared
+    by the elastic-recovery tests so both exercise the same fault."""
+    from defer_tpu.ops.registry import op_names, register_op
+
+    if "flaky" in op_names():
+        return
+
+    @register_op("flaky")
+    def flaky_apply(params, inputs, attrs):
+        if FLAKY["failures"] > 0:
+            FLAKY["failures"] -= 1
+            raise RuntimeError("transient stage failure")
+        return inputs[0]
+
+
 def write_keras_h5(path: str, weights: dict) -> None:
     """Write `{layer: [arrays]}` in the classic Keras save_weights h5
     layout (layer_names/weight_names attrs) for transplant tests."""

@@ -132,9 +132,6 @@ def test_stage_failure_surfaces_cleanly(devices):
     assert errors and "injected stage failure" in str(errors[0])
 
 
-_FLAKY = {"failures": 0}
-
-
 def test_stage_failure_redispatches_and_recovers(devices):
     """Elastic recovery: a transiently failing stage triggers a health
     probe + pipeline rebuild and the failed microbatch is retried —
@@ -144,17 +141,10 @@ def test_stage_failure_redispatches_and_recovers(devices):
     import numpy as np
 
     from defer_tpu.graph.ir import GraphBuilder
-    from defer_tpu.ops.registry import op_names, register_op
+    from tests.conftest import FLAKY, register_flaky_op
 
-    if "flaky" not in op_names():
-        @register_op("flaky")
-        def flaky_apply(params, inputs, attrs):
-            if _FLAKY["failures"] > 0:
-                _FLAKY["failures"] -= 1
-                raise RuntimeError("transient stage failure")
-            return inputs[0]
-
-    _FLAKY["failures"] = 1  # first build fails, rebuild heals
+    register_flaky_op()
+    FLAKY["failures"] = 1  # first build fails, rebuild heals
 
     b = GraphBuilder("flaky_model")
     x = b.input()
@@ -181,7 +171,7 @@ def test_stage_failure_redispatches_and_recovers(devices):
     outs = [outq.get(timeout=120), outq.get(timeout=120)]
     t.join(timeout=60)
     assert not t.is_alive()
-    assert _FLAKY["failures"] == 0
+    assert FLAKY["failures"] == 0
     want = np.asarray(g.apply(params, xin))
     for got in outs:
         np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)

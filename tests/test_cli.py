@@ -1,0 +1,51 @@
+"""Package CLI surface (`python -m defer_tpu`)."""
+
+import json
+
+import pytest
+
+from defer_tpu.__main__ import main
+
+
+def test_info(capsys):
+    main(["info"])
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["topology"]["num_devices"] >= 1
+    assert "resnet50" in doc["models"] and "vit_b16" in doc["models"]
+    assert doc["num_ops"] > 20
+
+
+def test_partition_auto(capsys):
+    main(["partition", "resnet50", "--stages", "4", "--auto"])
+    out = capsys.readouterr().out
+    assert "4 stages" in out and "stage 3" in out
+    # FLOPs-balanced: no stage above 35% of the model.
+    shares = [
+        float(line.rsplit("(", 1)[1].rstrip("%)\n"))
+        for line in out.splitlines()
+        if line.strip().startswith("stage")
+    ]
+    assert len(shares) == 4 and max(shares) < 35.0
+
+
+def test_roofline_cli(capsys):
+    main(
+        [
+            "roofline",
+            "vit_tiny",
+            "--batch",
+            "8",
+            "--device-kind",
+            "TPU v5 lite",
+            "--top",
+            "2",
+        ]
+    )
+    out = capsys.readouterr().out
+    # vit_tiny at batch 8 is tiny — top nodes are its dense layers.
+    assert "roofline[TPU v5 lite]" in out and "bound:" in out
+
+
+def test_unknown_command_rejected():
+    with pytest.raises(SystemExit):
+        main(["frobnicate"])

@@ -203,3 +203,51 @@ def test_replicated_pipeline_device_budget_checked(devices):
         ReplicatedPipeline(
             stages, params, devices[:3], config=F32, num_replicas=2
         )
+
+
+def test_replicated_run_defer_redispatches_and_recovers(devices):
+    """Elastic recovery composes with replicas: a transient failure
+    rebuilds the REPLICATED pipeline (same replica count) and the
+    stream completes in order."""
+    import queue
+    import threading
+
+    from defer_tpu.api import DEFER
+    from tests.conftest import FLAKY, register_flaky_op
+
+    register_flaky_op()
+    FLAKY["failures"] = 1
+
+    from defer_tpu.graph.ir import GraphBuilder
+
+    b = GraphBuilder("flaky_rp")
+    x = b.input()
+    h = b.add("dense", x, name="s0", features=4)
+    h = b.add("flaky", h, name="wobble")
+    g = b.build(h)
+    params = {
+        "input": {}, "wobble": {},
+        "s0": {"kernel": jnp.ones((8, 4)), "bias": jnp.zeros(4)},
+    }
+
+    defer = DEFER(devices[:4], config=F32)
+    inq: "queue.Queue" = queue.Queue()
+    outq: "queue.Queue" = queue.Queue()
+    xs = [jnp.full((2, 8), float(i)) for i in range(6)]
+    for v in xs:
+        inq.put(v)
+    inq.put(None)
+    t = threading.Thread(
+        target=defer.run_defer, args=(g, ["s0"], inq, outq),
+        kwargs={"params": params, "replicas": 2}, daemon=True,
+    )
+    t.start()
+    outs = [outq.get(timeout=120) for _ in range(6)]
+    t.join(timeout=60)
+    assert not t.is_alive()
+    assert FLAKY["failures"] == 0
+    assert defer.last_pipeline.num_replicas == 2  # rebuilt, same shape
+    for v, got in zip(xs, outs):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(g.apply(params, v)), rtol=1e-6
+        )
