@@ -347,3 +347,40 @@ def restore_sharded(dirpath: str, like: Any) -> Any:
         )
     treedef = jax.tree_util.tree_structure(like)
     return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+# -- orbax interop ---------------------------------------------------------
+
+
+def save_orbax(path: str, tree: Any) -> None:
+    """Write a pytree as an orbax StandardCheckpoint — ecosystem
+    interop so training stacks already standardized on orbax (flax,
+    maxtext-style setups) can consume this framework's states without
+    the native format. The native format (save_pytree) stays the
+    default: single file, codec-compressed, no directory protocol."""
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        # force=True: overwrite like the native save_pytree does
+        # (atomic replace), so the two save paths are interchangeable.
+        ckptr.save(os.path.abspath(path), tree, force=True)
+
+
+def load_orbax(path: str, template: Any) -> Any:
+    """Inverse of save_orbax; `template` supplies structure/shapes/
+    dtypes (abstract leaves are fine) exactly like load_pytree."""
+    import orbax.checkpoint as ocp
+
+    def spec(a):
+        # Abstract leaves (ShapeDtypeStruct, jax.eval_shape results)
+        # already carry shape/dtype; only genuine values need asarray.
+        if hasattr(a, "shape") and hasattr(a, "dtype"):
+            return jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+        arr = jnp.asarray(a)
+        return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(
+            os.path.abspath(path),
+            jax.tree_util.tree_map(spec, template),
+        )
