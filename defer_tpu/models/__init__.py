@@ -111,6 +111,7 @@ def _load_zoo() -> None:
 
     for mod in (
         "bert",
+        "densenet",
         "efficientnet",
         "inception",
         "inception_resnet",

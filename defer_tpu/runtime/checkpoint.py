@@ -361,8 +361,12 @@ def save_orbax(path: str, tree: Any) -> None:
     import orbax.checkpoint as ocp
 
     with ocp.StandardCheckpointer() as ckptr:
-        # force=True: overwrite like the native save_pytree does
-        # (atomic replace), so the two save paths are interchangeable.
+        # force=True allows repeated saves to one path. NOTE: unlike
+        # the native save_pytree (tmp file + os.replace), orbax
+        # removes the old checkpoint BEFORE committing the new one —
+        # a crash mid-save can lose both. For crash-safe rotation,
+        # save to a fresh path per step (orbax's CheckpointManager
+        # pattern) or use the native format.
         ckptr.save(os.path.abspath(path), tree, force=True)
 
 
@@ -374,10 +378,16 @@ def load_orbax(path: str, template: Any) -> Any:
     def spec(a):
         # Abstract leaves (ShapeDtypeStruct, jax.eval_shape results)
         # already carry shape/dtype; only genuine values need asarray.
+        # Template shardings pass through — restoring onto a different
+        # topology must honor the CALLER's shardings, not whatever the
+        # file recorded (same contract as restore_sharded).
+        sharding = getattr(a, "sharding", None)
         if hasattr(a, "shape") and hasattr(a, "dtype"):
-            return jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+            return jax.ShapeDtypeStruct(
+                tuple(a.shape), a.dtype, sharding=sharding
+            )
         arr = jnp.asarray(a)
-        return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+        return jax.ShapeDtypeStruct(arr.shape, arr.dtype, sharding=sharding)
 
     with ocp.StandardCheckpointer() as ckptr:
         return ckptr.restore(

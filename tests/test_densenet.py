@@ -1,0 +1,94 @@
+"""DenseNet: dense-connectivity stress case for the partitioner (only
+block concat outputs and transition layers are valid cuts — never a
+dense layer's internal branch) + real tf.keras numerical parity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from defer_tpu.config import DeferConfig
+from defer_tpu.graph.partition import (
+    PartitionError,
+    partition,
+    validate_cut_points,
+)
+from defer_tpu.models import get_model
+from defer_tpu.parallel.pipeline import Pipeline
+
+F32 = DeferConfig(compute_dtype=jnp.float32)
+
+
+def test_densenet121_builds_with_expected_head():
+    model = get_model("densenet121")
+    params = model.graph.init(jax.random.key(0), (1, 64, 64, 3))
+    spec = model.graph.output_spec(params, (1, 64, 64, 3))
+    assert spec.shape == (1, 1000)
+    # DenseNet-121 final feature width: 1024.
+    assert params["predictions"]["kernel"].shape == (1024, 1000)
+    # Every block concat + 3 transitions are valid cuts: 58+3.
+    assert len(model.cut_candidates) == 6 + 12 + 24 + 16 + 3
+    validate_cut_points(model.graph, model.cut_candidates)
+
+
+def test_densenet169_builds_with_expected_head():
+    model = get_model("densenet169")
+    params = model.graph.init(jax.random.key(0), (1, 64, 64, 3))
+    spec = model.graph.output_spec(params, (1, 64, 64, 3))
+    assert spec.shape == (1, 1000)
+    # DenseNet-169 final feature width: 1664.
+    assert params["predictions"]["kernel"].shape == (1664, 1000)
+    assert len(model.cut_candidates) == 6 + 12 + 32 + 32 + 3
+    validate_cut_points(model.graph, model.cut_candidates)
+
+
+def test_densenet_intra_layer_cut_rejected():
+    """The BN-ReLU-conv branch inside a dense layer runs parallel to
+    the concat skip, so a cut through it must be refused (the reference
+    would silently miscompile it, reference src/dag_util.py:11-27) —
+    while the concat output itself is a valid cut."""
+    model = get_model("densenet121")
+    with pytest.raises(PartitionError, match="crosses"):
+        partition(model.graph, ["conv3_block2_1_relu"])
+    partition(model.graph, ["conv3_block2_concat"])  # valid
+
+
+def test_densenet_pipeline_composes(devices):
+    model = get_model("densenet121")
+    params = model.graph.init(jax.random.key(0), (1, 64, 64, 3))
+    x = jax.random.normal(jax.random.key(1), (1, 64, 64, 3))
+    want = jax.jit(model.graph.apply)(params, x)
+    stages = partition(model.graph, model.default_cuts(4))
+    pipe = Pipeline(stages, params, devices[:4], config=F32)
+    got = pipe.warmup(x)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_densenet121_keras_parity():
+    """Numerical parity with the real tf.keras DenseNet121 (random
+    weights, no network) through the transplant path — node names match
+    real Keras layer names identically, so no name_map is needed."""
+    tf = pytest.importorskip("tensorflow")
+
+    from defer_tpu.models.transplant import KerasWeights, transplant
+
+    keras_model = tf.keras.applications.DenseNet121(
+        weights=None, input_shape=(224, 224, 3)
+    )
+    model = get_model("densenet121")
+    params = model.init(jax.random.key(0))
+    weights = {
+        l.name: l.get_weights() for l in keras_model.layers if l.get_weights()
+    }
+    params2 = transplant(
+        model.graph, params, KerasWeights(weights), strict=True
+    )
+
+    x = np.random.default_rng(0).standard_normal((1, 224, 224, 3)).astype(
+        np.float32
+    )
+    want = keras_model(x, training=False).numpy()
+    got = np.asarray(jax.jit(model.graph.apply)(params2, jnp.asarray(x)))
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-5)
