@@ -380,8 +380,16 @@ def load_orbax(path: str, template: Any) -> Any:
         # already carry shape/dtype; only genuine values need asarray.
         # Template shardings pass through — restoring onto a different
         # topology must honor the CALLER's shardings, not whatever the
-        # file recorded (same contract as restore_sharded).
+        # file recorded (same contract as restore_sharded) — EXCEPT
+        # default-single-device shardings, which must restore
+        # uncommitted: a committed scalar makes the next jit reject it
+        # alongside multi-device params (same special case as
+        # restore_sharded above).
         sharding = getattr(a, "sharding", None)
+        if isinstance(
+            sharding, SingleDeviceSharding
+        ) and sharding.device_set == {jax.local_devices()[0]}:
+            sharding = None
         if hasattr(a, "shape") and hasattr(a, "dtype"):
             return jax.ShapeDtypeStruct(
                 tuple(a.shape), a.dtype, sharding=sharding
@@ -389,8 +397,26 @@ def load_orbax(path: str, template: Any) -> Any:
         arr = jnp.asarray(a)
         return jax.ShapeDtypeStruct(arr.shape, arr.dtype, sharding=sharding)
 
+    specs = jax.tree_util.tree_map(spec, template)
     with ocp.StandardCheckpointer() as ckptr:
-        return ckptr.restore(
-            os.path.abspath(path),
-            jax.tree_util.tree_map(spec, template),
-        )
+        restored = ckptr.restore(os.path.abspath(path), specs)
+    # orbax returns every leaf committed; when the tree mixes
+    # multi-device params with default-device scalars, the committed
+    # scalars would make the next jit raise 'incompatible devices'.
+    # Rewrap just the default-device leaves (host round trip only for
+    # those, typically step counters) — noop for uniform trees.
+    leaves = jax.tree_util.tree_leaves(specs)
+    has_multi = any(
+        getattr(s, "sharding", None) is not None
+        and len(s.sharding.device_set) > 1
+        for s in leaves
+    )
+    if not has_multi:
+        return restored
+
+    def uncommit(s, v):
+        if getattr(s, "sharding", None) is None:
+            return jnp.asarray(np.asarray(v))
+        return v
+
+    return jax.tree_util.tree_map(uncommit, specs, restored)

@@ -278,3 +278,38 @@ def test_orbax_overwrite_and_abstract_template(tmp_path):
     abstract = {"w": jax.ShapeDtypeStruct((2, 2), jnp.float32)}
     back = load_orbax(path, abstract)
     np.testing.assert_array_equal(np.asarray(back["w"]), np.asarray(tree["w"]))
+
+
+def test_orbax_mixed_tree_scalars_restore_jit_compatible(
+    tmp_path, devices
+):
+    """Same jit-compatibility contract as restore_sharded: when the
+    tree mixes multi-device params with default-device scalars, the
+    scalars must come back UNCOMMITTED, or the next jit rejects them
+    alongside the sharded params ('incompatible devices')."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import pytest
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    pytest.importorskip("orbax.checkpoint")
+    from defer_tpu.parallel.mesh import make_mesh
+    from defer_tpu.runtime.checkpoint import load_orbax, save_orbax
+
+    mesh = make_mesh({"data": 2}, devices[:2])
+    tree = {
+        "step": jnp.int32(3),
+        "w": jax.device_put(
+            jnp.arange(4.0), NamedSharding(mesh, P("data"))
+        ),
+    }
+    path = str(tmp_path / "ck")
+    save_orbax(path, tree)
+    back = load_orbax(path, tree)
+    assert not back["step"]._committed
+    assert back["w"].sharding == tree["w"].sharding
+    # The restored mix must be jit-consumable in one computation.
+    out = jax.jit(lambda s, w: w.sum() + s)(back["step"], back["w"])
+    np.testing.assert_allclose(float(out), 9.0)
