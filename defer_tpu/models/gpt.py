@@ -85,13 +85,18 @@ class GptDecoder:
     # -- one step (prefill or decode) -------------------------------------
 
     def _split_heads(self, x: jax.Array) -> jax.Array:
+        # Head count inferred from the actual width: under tensor
+        # parallelism each shard sees D/tp == local_heads * Dh.
         b, t, d = x.shape
-        h = self.cfg.num_heads
-        return x.reshape(b, t, h, d // h).transpose(0, 2, 1, 3)
+        dh = self.cfg.dim // self.cfg.num_heads
+        return x.reshape(b, t, d // dh, dh).transpose(0, 2, 1, 3)
 
-    def _block(self, p: dict, x, k_cache, v_cache, pos):
+    def _block(self, p: dict, x, k_cache, v_cache, pos, tp_axis=None):
         """One decoder block on [B, T, D] with cache update; returns
-        (out, new_k, new_v)."""
+        (out, new_k, new_v). Under shard_map with tp_axis set, the
+        projections arrive column-sharded (this shard's head group),
+        the caches hold only local heads, and wo/w2 are row-sharded
+        with psum — the Megatron pattern on the decode path."""
         cfg = self.cfg
         dt = x.dtype
         h = _layer_norm(x, p["ln1_scale"], p["ln1_bias"], cfg.layer_norm_eps)
@@ -119,27 +124,26 @@ class GptDecoder:
         logits = jnp.where(j <= tt, logits, -jnp.inf)
         weights = jax.nn.softmax(logits, axis=-1).astype(dt)
         attn = jnp.einsum("bhts,bhsd->bhtd", weights, v_cache)
-        b = attn.shape[0]
-        attn = attn.transpose(0, 2, 1, 3).reshape(b, t, cfg.dim)
-        attn = attn @ p["wo"].astype(dt) + p["bo"].astype(dt)
+        b, h_local = attn.shape[0], attn.shape[1]
+        attn = attn.transpose(0, 2, 1, 3).reshape(b, t, h_local * dh)
+        attn = attn @ p["wo"].astype(dt)
+        if tp_axis is not None:
+            attn = lax.psum(attn, tp_axis)
+        attn = attn + p["bo"].astype(dt)
         x = x + attn
         h2 = _layer_norm(x, p["ln2_scale"], p["ln2_bias"], cfg.layer_norm_eps)
         ff = h2 @ p["w1"].astype(dt) + p["b1"].astype(dt)
         ff = jax.nn.gelu(ff)
-        ff = ff @ p["w2"].astype(dt) + p["b2"].astype(dt)
-        return x + ff, k_cache, v_cache
+        ff = ff @ p["w2"].astype(dt)
+        if tp_axis is not None:
+            ff = lax.psum(ff, tp_axis)
+        return x + ff + p["b2"].astype(dt), k_cache, v_cache
 
-    def make_step(self, *, donate: bool = True):
-        """Jitted (params, cache, ids [B, T]) -> (logits [B, T, V],
-        cache). With donate=True (default) the cache argument's buffers
-        are reused in place — the serving configuration. Memoized per
-        donate flag: jit's cache is keyed on the function object, so a
-        fresh closure per call would re-trace/re-compile every shape."""
-        cached = getattr(self, "_steps", None)
-        if cached is None:
-            cached = self._steps = {}
-        if donate in cached:
-            return cached[donate]
+    def _step_fn(self, tp_axis: str | None = None):
+        """The ONE step body (embed -> scan over blocks -> final LN ->
+        tied head) shared by the single-device and tensor-parallel
+        paths; the tp variant only adds psum inside _block and a
+        shard_map wrapper around this."""
         cfg = self.cfg
         cd = self.compute_dtype
 
@@ -155,7 +159,7 @@ class GptDecoder:
             def body(carry, layer):
                 x = carry
                 p, kc, vc = layer
-                out, kc, vc = self._block(p, x, kc, vc, pos)
+                out, kc, vc = self._block(p, x, kc, vc, pos, tp_axis=tp_axis)
                 return out, (kc, vc)
 
             x, (new_k, new_v) = lax.scan(
@@ -171,9 +175,25 @@ class GptDecoder:
             new_cache = {"k": new_k, "v": new_v, "pos": pos + t}
             return logits, new_cache
 
-        fn = jax.jit(step, donate_argnums=(1,) if donate else ())
-        cached[donate] = fn
-        return fn
+        return step
+
+    def _memoized(self, donate: bool, build):
+        """jit's cache is keyed on the function object, so a fresh
+        closure per call would re-trace/re-compile every shape."""
+        cached = getattr(self, "_steps", None)
+        if cached is None:
+            cached = self._steps = {}
+        if donate not in cached:
+            cached[donate] = jax.jit(
+                build(), donate_argnums=(1,) if donate else ()
+            )
+        return cached[donate]
+
+    def make_step(self, *, donate: bool = True):
+        """Jitted (params, cache, ids [B, T]) -> (logits [B, T, V],
+        cache). With donate=True (default) the cache argument's buffers
+        are reused in place — the serving configuration."""
+        return self._memoized(donate, self._step_fn)
 
     # -- generation --------------------------------------------------------
 
@@ -228,6 +248,110 @@ class GptDecoder:
         cache = self.init_cache(ids.shape[0])
         logits, _ = self.make_step(donate=False)(params, cache, ids)
         return logits
+
+
+@dataclasses.dataclass
+class SpmdGptDecoder(GptDecoder):
+    """Tensor-parallel KV-cache decoding: one jitted shard_map step
+    over a 'model' mesh axis.
+
+    Each shard holds its head group's column-sharded q/k/v projections
+    and a cache of ONLY its local heads ([L, B, H/tp, S_max, Dh] per
+    device); attention is collective-free, and the wo/w2 row-parallel
+    matmuls psum over ICI — decode's per-token latency then scales
+    with 1/tp of the weights read per chip, which is what serving
+    large models needs (weights, not activations, dominate decode HBM
+    traffic)."""
+
+    mesh: Any = None
+    tp_axis: str = "model"
+
+    def __post_init__(self):
+        super().__post_init__()
+        if self.mesh is None or self.tp_axis not in self.mesh.axis_names:
+            raise ValueError(
+                f"SpmdGptDecoder needs a mesh with a {self.tp_axis!r} axis"
+            )
+        tp = self.mesh.shape[self.tp_axis]
+        cfg = self.cfg
+        if cfg.num_heads % tp or cfg.dim % tp or cfg.ffn_dim % tp:
+            raise ValueError(
+                f"heads={cfg.num_heads}, dim={cfg.dim}, "
+                f"ffn_dim={cfg.ffn_dim} must all divide by tp={tp}"
+            )
+
+    def _specs(self):
+        from defer_tpu.parallel.transformer_stack import stack_specs
+        from jax.sharding import PartitionSpec as P
+
+        tp = self.tp_axis
+        return {
+            "token_embedding": P(),
+            "pos_embedding": P(),
+            "final_ln_scale": P(),
+            "final_ln_bias": P(),
+            "stack": stack_specs(None, tp),
+        }
+
+    def shard_params(self, params: dict) -> dict:
+        """Place replicated-init params onto the mesh (column/row
+        sharded stack, replicated embeddings)."""
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        return jax.device_put(
+            params,
+            jax.tree_util.tree_map(
+                lambda s: NamedSharding(self.mesh, s),
+                self._specs(),
+                is_leaf=lambda s: isinstance(s, P),
+            ),
+        )
+
+    def _cache_spec(self):
+        from jax.sharding import PartitionSpec as P
+
+        tp = self.tp_axis
+        return {
+            # Cache heads shard over tp (axis 2 of [L,B,H,S,Dh]).
+            "k": P(None, None, tp, None, None),
+            "v": P(None, None, tp, None, None),
+            "pos": P(),
+        }
+
+    def make_step(self, *, donate: bool = True):
+        from jax.sharding import PartitionSpec as P
+
+        def build():
+            cache_spec = self._cache_spec()
+            return jax.shard_map(
+                self._step_fn(tp_axis=self.tp_axis),
+                mesh=self.mesh,
+                in_specs=(self._specs(), cache_spec, P()),
+                out_specs=(P(), cache_spec),
+            )
+
+        return self._memoized(donate, build)
+
+    def init_cache(self, batch: int) -> dict:
+        from jax.sharding import NamedSharding
+
+        cfg = self.cfg
+        dh = cfg.dim // cfg.num_heads
+        shape = (cfg.num_layers, batch, cfg.num_heads, cfg.max_len, dh)
+        spec = self._cache_spec()
+        # Allocate DIRECTLY sharded: materializing the full replicated
+        # cache on device 0 first would transiently need tp x the
+        # per-device footprint — an OOM at serving scale.
+        kv_sh = NamedSharding(self.mesh, spec["k"])
+        return {
+            "k": jnp.zeros(shape, self.compute_dtype, device=kv_sh),
+            "v": jnp.zeros(shape, self.compute_dtype, device=kv_sh),
+            "pos": jax.device_put(
+                jnp.zeros((), jnp.int32),
+                NamedSharding(self.mesh, spec["pos"]),
+            ),
+        }
 
 
 def tiny_gpt(seq_len: int = 32) -> GptDecoder:

@@ -73,3 +73,59 @@ def test_mha_auto_falls_back_off_tpu():
     q, k, v = _qkv((b, s, d), seed=5)
     out = multi_head_attention(q, k, v, num_heads=h)
     assert out.shape == (b, s, d)
+
+
+def test_pallas_availability_detection(monkeypatch):
+    """The 'auto' gate: pallas only on a DIRECTLY-attached TPU backend.
+    Tunneled plugins register under their own factory name while the
+    client claims platform 'tpu' — that mismatch must disable pallas
+    (a Mosaic compile on such transports hangs, not errors)."""
+    from types import SimpleNamespace
+
+    import jax
+
+    from defer_tpu.ops import attention
+
+    monkeypatch.delenv("DEFER_TPU_PALLAS", raising=False)
+    fake = SimpleNamespace(platform="tpu")
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(
+        jax.extend.backend, "get_backend", lambda: fake
+    )
+    from jax._src import xla_bridge as xb
+
+    # Registered under its own plugin name (e.g. 'axon') -> tunneled.
+    monkeypatch.setattr(xb, "_backends", {"axon": fake})
+    assert attention._pallas_available() is False
+    # Registered under the platform it claims -> direct TPU.
+    monkeypatch.setattr(xb, "_backends", {"tpu": fake})
+    assert attention._pallas_available() is True
+    # Env force wins in both directions.
+    monkeypatch.setenv("DEFER_TPU_PALLAS", "1")
+    monkeypatch.setattr(xb, "_backends", {"axon": fake})
+    assert attention._pallas_available() is True
+    monkeypatch.setenv("DEFER_TPU_PALLAS", "0")
+    monkeypatch.setattr(xb, "_backends", {"tpu": fake})
+    assert attention._pallas_available() is False
+
+
+def test_pallas_availability_fails_closed(monkeypatch):
+    """A broken probe (jax internals moved) must pick the XLA path —
+    wrongly enabling pallas on a tunneled backend hangs the transport."""
+    import warnings
+
+    import jax
+
+    from defer_tpu.ops import attention
+
+    monkeypatch.delenv("DEFER_TPU_PALLAS", raising=False)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+
+    def boom():
+        raise AttributeError("get_backend moved")
+
+    monkeypatch.setattr(jax.extend.backend, "get_backend", boom)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert attention._pallas_available() is False
+    assert any("probe failed" in str(x.message) for x in w)
