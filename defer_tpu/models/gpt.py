@@ -258,10 +258,11 @@ class SpmdGptDecoder(GptDecoder):
     Each shard holds its head group's column-sharded q/k/v projections
     and a cache of ONLY its local heads ([L, B, H/tp, S_max, Dh] per
     device); attention is collective-free, and the wo/w2 row-parallel
-    matmuls psum over ICI — decode's per-token latency then scales
-    with 1/tp of the weights read per chip, which is what serving
-    large models needs (weights, not activations, dominate decode HBM
-    traffic)."""
+    matmuls psum over ICI. Per-chip decode weight traffic is the
+    BLOCK weights / tp plus the full (replicated) embedding/tied
+    head — block weights dominate for deep models; Megatron vocab
+    sharding of the embedding + head is the known next step if the
+    vocab matrix ever dominates."""
 
     mesh: Any = None
     tp_axis: str = "model"
