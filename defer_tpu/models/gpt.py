@@ -142,15 +142,31 @@ class GptDecoder:
     def _step_fn(self, tp_axis: str | None = None):
         """The ONE step body (embed -> scan over blocks -> final LN ->
         tied head) shared by the single-device and tensor-parallel
-        paths; the tp variant only adds psum inside _block and a
-        shard_map wrapper around this."""
+        paths; the tp variant adds psum inside _block, Megatron vocab
+        sharding around the embedding/tied head, and a shard_map
+        wrapper around this."""
         cfg = self.cfg
         cd = self.compute_dtype
 
         def step(params, cache, ids):
             b, t = ids.shape
             pos = cache["pos"]
-            emb = jnp.take(params["token_embedding"], ids, axis=0)
+            table = params["token_embedding"]
+            if tp_axis is None:
+                emb = jnp.take(table, ids, axis=0)
+            else:
+                # Vocab-row sharding: this shard owns rows
+                # [v0, v0 + V_local); out-of-range ids contribute
+                # zeros and the psum assembles full embeddings.
+                v_local = table.shape[0]
+                v0 = lax.axis_index(tp_axis) * v_local
+                local_ids = ids - v0
+                in_range = (local_ids >= 0) & (local_ids < v_local)
+                emb = jnp.take(
+                    table, jnp.clip(local_ids, 0, v_local - 1), axis=0
+                )
+                emb = jnp.where(in_range[..., None], emb, 0.0)
+                emb = lax.psum(emb, tp_axis)
             posv = lax.dynamic_slice_in_dim(
                 params["pos_embedding"], pos, t, axis=0
             )
@@ -171,7 +187,11 @@ class GptDecoder:
                 params["final_ln_bias"],
                 cfg.layer_norm_eps,
             )
-            logits = x @ params["token_embedding"].T  # tied head, fp32
+            # Tied head, fp32. Under tp each shard produces its vocab
+            # slice [B, T, Vpad/tp]; the caller's out_specs concatenate
+            # the slices into the global logits (no in-body collective,
+            # and shard_map's replication checking stays on).
+            logits = x @ params["token_embedding"].T
             new_cache = {"k": new_k, "v": new_v, "pos": pos + t}
             return logits, new_cache
 
@@ -258,11 +278,11 @@ class SpmdGptDecoder(GptDecoder):
     Each shard holds its head group's column-sharded q/k/v projections
     and a cache of ONLY its local heads ([L, B, H/tp, S_max, Dh] per
     device); attention is collective-free, and the wo/w2 row-parallel
-    matmuls psum over ICI. Per-chip decode weight traffic is the
-    BLOCK weights / tp plus the full (replicated) embedding/tied
-    head — block weights dominate for deep models; Megatron vocab
-    sharding of the embedding + head is the known next step if the
-    vocab matrix ever dominates."""
+    matmuls psum over ICI; the embedding/tied head is vocab-row
+    sharded (masked lookup + psum in, per-shard logits + all_gather
+    out) — so EVERY weight matrix is read 1/tp per chip, which is
+    what decode latency needs (weights, not activations, dominate
+    decode HBM traffic)."""
 
     mesh: Any = None
     tp_axis: str = "model"
@@ -280,6 +300,10 @@ class SpmdGptDecoder(GptDecoder):
                 f"heads={cfg.num_heads}, dim={cfg.dim}, "
                 f"ffn_dim={cfg.ffn_dim} must all divide by tp={tp}"
             )
+        # Real vocab sizes (50257, 32000, ...) rarely divide by tp:
+        # pad the sharded table instead of rejecting (padded rows are
+        # zeros, masked out of lookups and sliced off the logits).
+        self._vocab_padded = -(-cfg.vocab_size // tp) * tp
 
     def _specs(self):
         from defer_tpu.parallel.transformer_stack import stack_specs
@@ -287,7 +311,9 @@ class SpmdGptDecoder(GptDecoder):
 
         tp = self.tp_axis
         return {
-            "token_embedding": P(),
+            # Megatron vocab sharding: embedding rows over tp; the
+            # tied head reuses the same shards.
+            "token_embedding": P(tp, None),
             "pos_embedding": P(),
             "final_ln_scale": P(),
             "final_ln_bias": P(),
@@ -295,11 +321,19 @@ class SpmdGptDecoder(GptDecoder):
         }
 
     def shard_params(self, params: dict) -> dict:
-        """Place replicated-init params onto the mesh (column/row
-        sharded stack, replicated embeddings)."""
+        """Place replicated-init params onto the mesh: column/row
+        sharded stack, vocab-row sharded embedding/tied head (padded
+        to a tp multiple), replicated norms/positions."""
         from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as P
 
+        emb = params["token_embedding"]
+        pad = self._vocab_padded - emb.shape[0]
+        if pad:
+            params = {
+                **params,
+                "token_embedding": jnp.pad(emb, ((0, pad), (0, 0))),
+            }
         return jax.device_put(
             params,
             jax.tree_util.tree_map(
@@ -323,14 +357,26 @@ class SpmdGptDecoder(GptDecoder):
     def make_step(self, *, donate: bool = True):
         from jax.sharding import PartitionSpec as P
 
+        vocab = self.cfg.vocab_size
+
         def build():
             cache_spec = self._cache_spec()
-            return jax.shard_map(
+            smapped = jax.shard_map(
                 self._step_fn(tp_axis=self.tp_axis),
                 mesh=self.mesh,
                 in_specs=(self._specs(), cache_spec, P()),
-                out_specs=(P(), cache_spec),
+                # Logits stay vocab-sharded inside; shard_map itself
+                # concatenates the [B, T, Vpad/tp] slices.
+                out_specs=(P(None, None, self.tp_axis), cache_spec),
             )
+
+            def step(params, cache, ids):
+                logits, cache = smapped(params, cache, ids)
+                # Drop the pad vocab rows (zeros from padded weights —
+                # leaving them in could win an argmax).
+                return logits[..., :vocab], cache
+
+            return step
 
         return self._memoized(donate, build)
 

@@ -137,6 +137,9 @@ def test_tp_sharded_decode_matches_single_device(devices):
     # The stack really is sharded over the model axis.
     wq = tparams["stack"]["wq"]
     assert {s.data.shape for s in wq.addressable_shards} == {(3, 64, 32)}
+    # ... and so is the vocab matrix (Megatron embedding sharding).
+    emb = tparams["token_embedding"]
+    assert {s.data.shape for s in emb.addressable_shards} == {(48, 64)}
 
     ids = jax.random.randint(jax.random.key(1), (2, 8), 0, 96)
     want = ref.reference_logits(params, ids)
@@ -175,3 +178,36 @@ def test_spmd_decoder_validates_mesh_and_divisibility(devices):
     mesh3 = make_mesh({"model": 3}, devices[:3])
     with pytest.raises(ValueError, match="divide"):
         SpmdGptDecoder(cfg, mesh=mesh3)
+
+
+def test_tp_decode_with_non_divisible_vocab(devices):
+    """Vocab 49 on tp=2 pads to 50 internally; outputs stay [.., 49]
+    and token-exact vs the single-device decoder (pad rows must never
+    win an argmax)."""
+    from defer_tpu.models.gpt import SpmdGptDecoder
+    from defer_tpu.parallel.mesh import make_mesh
+    from defer_tpu.parallel.transformer_stack import TransformerConfig
+
+    cfg = TransformerConfig(
+        num_layers=2, dim=32, num_heads=4, ffn_dim=64,
+        vocab_size=49, max_len=16, norm_style="pre",
+    )
+    ref = GptDecoder(cfg, compute_dtype=jnp.float32)
+    params = ref.init(jax.random.key(0))
+    mesh = make_mesh({"model": 2}, devices[:2])
+    tp = SpmdGptDecoder(cfg, compute_dtype=jnp.float32, mesh=mesh)
+    tparams = tp.shard_params(params)
+    assert tparams["token_embedding"].shape == (50, 32)  # padded
+
+    ids = jax.random.randint(jax.random.key(1), (1, 6), 0, 49)
+    want = ref.reference_logits(params, ids)
+    step = tp.make_step(donate=False)
+    logits, _ = step(tparams, tp.init_cache(1), ids)
+    assert logits.shape == (1, 6, 49)
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(want), rtol=2e-4, atol=2e-4
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ref.generate(params, ids[:, :3], 5)),
+        np.asarray(tp.generate(tparams, ids[:, :3], 5)),
+    )
