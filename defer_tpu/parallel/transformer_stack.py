@@ -48,6 +48,10 @@ class TransformerConfig:
     # norm-then-sublayer (ln params then normalize the sublayer INPUT,
     # and the residual stream is never normalized in-block).
     norm_style: str = "post"
+    # Causal (decoder-style) attention masking: with norm_style="pre"
+    # this makes the SPMD stack a trainable GPT — the same params the
+    # KV-cache decoder (defer_tpu/models/gpt.py) serves.
+    causal: bool = False
 
 
 def init_stack(
@@ -244,6 +248,7 @@ def block_apply(
         k,
         v,
         num_heads=local_heads,
+        causal=cfg.causal,
         use_pallas="auto",
         sp_axis=sp_axis,
         sp_strategy=sp_strategy,

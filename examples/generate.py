@@ -50,6 +50,13 @@ def main() -> None:
     ap.add_argument("--temperature", type=float, default=0.0)
     args = ap.parse_args()
 
+    if args.prompt_len + args.steps + 1 > args.max_len:
+        raise SystemExit(
+            f"--prompt-len {args.prompt_len} + --steps {args.steps} + 1 "
+            f"exceeds --max-len {args.max_len}: the cache would clamp and "
+            "benchmark degenerate work"
+        )
+
     cfg = TransformerConfig(
         num_layers=args.layers,
         dim=args.dim,
@@ -81,7 +88,19 @@ def main() -> None:
     logits.block_until_ready()
     t_prefill_compile = time.perf_counter() - t0
 
-    nxt = jnp.argmax(logits[:, -1:], axis=-1).astype(prompt.dtype)
+    rng = jax.random.key(7)
+
+    def pick(logits_last, rng):
+        if args.temperature > 0:
+            rng, sub = jax.random.split(rng)
+            tok = jax.random.categorical(
+                sub, logits_last / args.temperature, axis=-1
+            )
+        else:
+            tok = jnp.argmax(logits_last, axis=-1)
+        return tok.astype(prompt.dtype), rng
+
+    nxt, rng = pick(logits[:, -1:], rng)
     t0 = time.perf_counter()
     logits, cache = step(params, cache, nxt)
     logits.block_until_ready()
@@ -89,7 +108,7 @@ def main() -> None:
 
     t0 = time.perf_counter()
     for _ in range(args.steps):
-        nxt = jnp.argmax(logits[:, -1:], axis=-1).astype(prompt.dtype)
+        nxt, rng = pick(logits[:, -1:], rng)
         logits, cache = step(params, cache, nxt)
     logits.block_until_ready()
     dt = time.perf_counter() - t0

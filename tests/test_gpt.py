@@ -211,3 +211,79 @@ def test_tp_decode_with_non_divisible_vocab(devices):
         np.asarray(ref.generate(params, ids[:, :3], 5)),
         np.asarray(tp.generate(tparams, ids[:, :3], 5)),
     )
+
+
+def test_causal_stack_matches_decoder_blocks():
+    """TransformerConfig(causal=True, norm_style='pre') makes
+    layers_apply (the trainable SPMD stack) produce the decoder's
+    block outputs exactly — the same params train and serve."""
+    from defer_tpu.parallel.transformer_stack import layers_apply
+
+    dec = tiny_gpt()
+    import dataclasses
+
+    cfg_causal = dataclasses.replace(dec.cfg, causal=True)
+    params = dec.init(jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (2, 8), 0, 128)
+
+    # Decoder path: embed -> cached blocks (fresh cache, full seq).
+    want = dec.reference_logits(params, ids)
+
+    # Stack path: same embed, causal layers_apply, same final LN/head.
+    emb = jnp.take(params["token_embedding"], ids, axis=0)
+    emb = emb + params["pos_embedding"][: ids.shape[1]]
+    x = layers_apply(params["stack"], emb.astype(jnp.float32), cfg_causal)
+    from defer_tpu.parallel.transformer_stack import _layer_norm
+
+    x = _layer_norm(
+        x.astype(jnp.float32),
+        params["final_ln_scale"],
+        params["final_ln_bias"],
+        dec.cfg.layer_norm_eps,
+    )
+    got = x @ params["token_embedding"].T
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_causal_gpt_trains_through_spmd_pipeline(devices):
+    """End-to-end decoder training: SpmdBert machinery with
+    causal+pre-LN config, dp x pp mesh, loss decreases."""
+    import optax
+
+    from defer_tpu.models.bert import SpmdBert
+    from defer_tpu.parallel.mesh import make_mesh
+    from defer_tpu.parallel.train import make_train_step
+
+    cfg = TransformerConfig(
+        num_layers=4, dim=32, num_heads=4, ffn_dim=64,
+        vocab_size=64, max_len=16, norm_style="pre", causal=True,
+    )
+    mesh = make_mesh({"data": 2, "stage": 2}, devices[:4])
+    sb = SpmdBert(mesh, cfg, compute_dtype=jnp.float32)
+    init_state, train_step = make_train_step(
+        sb, optax.adam(1e-2), num_classes=4
+    )
+    state = init_state(jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (3, 4, 8), 0, 64)
+    labels = jax.random.randint(jax.random.key(2), (3, 4), 0, 4)
+    state, loss0 = train_step(state, ids, labels)
+    for _ in range(5):
+        state, loss = train_step(state, ids, labels)
+    assert float(loss) < float(loss0)
+    # Mask sensitivity: the flag must actually reach the attention op —
+    # with identical params, causal and bidirectional pooled outputs
+    # differ (token 0 sees everything bidirectionally, only itself
+    # causally).
+    import dataclasses
+
+    sb_bidir = SpmdBert(
+        mesh, dataclasses.replace(cfg, causal=False),
+        compute_dtype=jnp.float32,
+    )
+    out_causal = sb.make_step()(state.params, ids)
+    out_bidir = sb_bidir.make_step()(state.params, ids)
+    assert not np.allclose(
+        np.asarray(out_causal), np.asarray(out_bidir)
+    )
