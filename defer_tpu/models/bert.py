@@ -216,7 +216,12 @@ class SpmdBert:
         }
 
     def make_step(self):
-        """Jitted (params, ids [M, B, S]) -> pooled [M, B, D]."""
+        """Jitted (params, ids [M, B, S]) -> pooled [M, B, D].
+        Memoized: jit's cache is keyed on the function object, so a
+        fresh closure per call would re-trace/re-compile every shape."""
+        cached = getattr(self, "_step", None)
+        if cached is not None:
+            return cached
         cfg = self.cfg
         cd = self.compute_dtype
 
@@ -252,7 +257,8 @@ class SpmdBert:
                 + params["pooler_b"].astype(cd)
             )
 
-        return jax.jit(step)
+        self._step = jax.jit(step)
+        return self._step
 
     def reference_apply(self, params: dict, ids: jax.Array) -> jax.Array:
         """Unpipelined single-program reference for correctness checks."""

@@ -38,6 +38,24 @@ from defer_tpu.parallel.transformer_stack import (
 )
 
 
+def sample_token(
+    logits_last: jax.Array,
+    rng: jax.Array,
+    temperature: float,
+) -> tuple[jax.Array, jax.Array]:
+    """One sampling policy for every decode loop (generate, examples):
+    greedy at temperature 0, categorical otherwise. Returns
+    (token_ids, next_rng)."""
+    if temperature > 0:
+        rng, sub = jax.random.split(rng)
+        tok = jax.random.categorical(
+            sub, logits_last / temperature, axis=-1
+        )
+    else:
+        tok = jnp.argmax(logits_last, axis=-1)
+    return tok, rng
+
+
 @dataclasses.dataclass
 class GptDecoder:
     """Decoder-only transformer with weight-tied output head."""
@@ -245,11 +263,7 @@ class GptDecoder:
         if rng is None:
             rng = jax.random.key(0)
         for i in range(num_steps):
-            if temperature > 0:
-                rng, sub = jax.random.split(rng)
-                nxt = jax.random.categorical(sub, last / temperature, axis=-1)
-            else:
-                nxt = jnp.argmax(last, axis=-1)
+            nxt, rng = sample_token(last, rng, temperature)
             nxt = nxt[:, None].astype(prompt_ids.dtype)
             ids = jnp.concatenate([ids, nxt], axis=1)
             if i + 1 < num_steps:

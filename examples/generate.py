@@ -88,16 +88,12 @@ def main() -> None:
     logits.block_until_ready()
     t_prefill_compile = time.perf_counter() - t0
 
+    from defer_tpu.models.gpt import sample_token
+
     rng = jax.random.key(7)
 
     def pick(logits_last, rng):
-        if args.temperature > 0:
-            rng, sub = jax.random.split(rng)
-            tok = jax.random.categorical(
-                sub, logits_last / args.temperature, axis=-1
-            )
-        else:
-            tok = jnp.argmax(logits_last, axis=-1)
+        tok, rng = sample_token(logits_last, rng, args.temperature)
         return tok.astype(prompt.dtype), rng
 
     nxt, rng = pick(logits[:, -1:], rng)
