@@ -217,11 +217,12 @@ class SpmdBert:
 
     def make_step(self):
         """Jitted (params, ids [M, B, S]) -> pooled [M, B, D].
-        Memoized: jit's cache is keyed on the function object, so a
-        fresh closure per call would re-trace/re-compile every shape."""
-        cached = getattr(self, "_step", None)
-        if cached is not None:
-            return cached
+        Memoized (defer_tpu/utils/memo.py)."""
+        from defer_tpu.utils.memo import cached_step
+
+        return cached_step(self, "step", self._build_step)
+
+    def _build_step(self):
         cfg = self.cfg
         cd = self.compute_dtype
 
@@ -257,8 +258,7 @@ class SpmdBert:
                 + params["pooler_b"].astype(cd)
             )
 
-        self._step = jax.jit(step)
-        return self._step
+        return jax.jit(step)
 
     def reference_apply(self, params: dict, ids: jax.Array) -> jax.Array:
         """Unpipelined single-program reference for correctness checks."""

@@ -216,16 +216,13 @@ class GptDecoder:
         return step
 
     def _memoized(self, donate: bool, build):
-        """jit's cache is keyed on the function object, so a fresh
-        closure per call would re-trace/re-compile every shape."""
-        cached = getattr(self, "_steps", None)
-        if cached is None:
-            cached = self._steps = {}
-        if donate not in cached:
-            cached[donate] = jax.jit(
-                build(), donate_argnums=(1,) if donate else ()
-            )
-        return cached[donate]
+        from defer_tpu.utils.memo import cached_step
+
+        return cached_step(
+            self,
+            donate,
+            lambda: jax.jit(build(), donate_argnums=(1,) if donate else ()),
+        )
 
     def make_step(self, *, donate: bool = True):
         """Jitted (params, cache, ids [B, T]) -> (logits [B, T, V],
@@ -300,6 +297,10 @@ class SpmdGptDecoder(GptDecoder):
 
     mesh: Any = None
     tp_axis: str = "model"
+    # Optional batch sharding: set to a mesh axis name (e.g. "data")
+    # to shard the cache/ids/logits batch dim over it — dp x tp
+    # serving in one program.
+    dp_axis: str | None = None
 
     def __post_init__(self):
         super().__post_init__()
@@ -307,6 +308,17 @@ class SpmdGptDecoder(GptDecoder):
             raise ValueError(
                 f"SpmdGptDecoder needs a mesh with a {self.tp_axis!r} axis"
             )
+        if self.dp_axis is not None:
+            if self.dp_axis not in self.mesh.axis_names:
+                raise ValueError(
+                    f"dp_axis {self.dp_axis!r} is not a mesh axis "
+                    f"({self.mesh.axis_names})"
+                )
+            if self.dp_axis == self.tp_axis:
+                raise ValueError(
+                    f"dp_axis and tp_axis must differ (both "
+                    f"{self.dp_axis!r})"
+                )
         tp = self.mesh.shape[self.tp_axis]
         cfg = self.cfg
         if cfg.num_heads % tp or cfg.dim % tp or cfg.ffn_dim % tp:
@@ -360,11 +372,12 @@ class SpmdGptDecoder(GptDecoder):
     def _cache_spec(self):
         from jax.sharding import PartitionSpec as P
 
-        tp = self.tp_axis
+        tp, dp = self.tp_axis, self.dp_axis
         return {
-            # Cache heads shard over tp (axis 2 of [L,B,H,S,Dh]).
-            "k": P(None, None, tp, None, None),
-            "v": P(None, None, tp, None, None),
+            # Cache batch shards over dp (axis 1), heads over tp
+            # (axis 2) of [L,B,H,S,Dh].
+            "k": P(None, dp, tp, None, None),
+            "v": P(None, dp, tp, None, None),
             "pos": P(),
         }
 
@@ -375,13 +388,14 @@ class SpmdGptDecoder(GptDecoder):
 
         def build():
             cache_spec = self._cache_spec()
+            dp = self.dp_axis
             smapped = jax.shard_map(
                 self._step_fn(tp_axis=self.tp_axis),
                 mesh=self.mesh,
-                in_specs=(self._specs(), cache_spec, P()),
+                in_specs=(self._specs(), cache_spec, P(dp, None)),
                 # Logits stay vocab-sharded inside; shard_map itself
-                # concatenates the [B, T, Vpad/tp] slices.
-                out_specs=(P(None, None, self.tp_axis), cache_spec),
+                # concatenates the [B/dp, T, Vpad/tp] slices.
+                out_specs=(P(dp, None, self.tp_axis), cache_spec),
             )
 
             def step(params, cache, ids):

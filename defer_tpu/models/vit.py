@@ -231,7 +231,13 @@ class SpmdVit:
         return x + params["pos"].astype(cd)
 
     def make_step(self):
-        """Jitted (params, images [M, B, H, W, 3]) -> logits [M, B, C]."""
+        """Jitted (params, images [M, B, H, W, 3]) -> logits [M, B, C].
+        Memoized (defer_tpu/utils/memo.py)."""
+        from defer_tpu.utils.memo import cached_step
+
+        return cached_step(self, "step", self._build_step)
+
+    def _build_step(self):
         cfg = self.cfg
 
         def stage_fn(stack_local, x):

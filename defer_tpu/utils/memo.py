@@ -1,0 +1,22 @@
+"""Per-instance memoization for jitted step builders.
+
+jax.jit's compilation cache is keyed on the function OBJECT: a method
+that returns `jax.jit(fresh_closure)` on every call re-traces and
+re-compiles every shape each time. Every `make_step`-style builder in
+the model layer routes through this one helper instead.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+
+def cached_step(obj: Any, key: Any, build: Callable[[], Any]) -> Any:
+    """Build-once per (instance, key); subsequent calls return the same
+    callable so jit's cache keeps working."""
+    cache = getattr(obj, "_step_cache", None)
+    if cache is None:
+        cache = obj._step_cache = {}
+    if key not in cache:
+        cache[key] = build()
+    return cache[key]

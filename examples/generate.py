@@ -28,7 +28,6 @@ import argparse
 import time
 
 import jax
-import jax.numpy as jnp
 
 from defer_tpu.models.gpt import GptDecoder, SpmdGptDecoder
 from defer_tpu.parallel.mesh import make_mesh

@@ -287,3 +287,73 @@ def test_causal_gpt_trains_through_spmd_pipeline(devices):
     assert not np.allclose(
         np.asarray(out_causal), np.asarray(out_bidir)
     )
+
+
+def test_dp_tp_decode_matches_single_device(devices):
+    """dp x tp serving mesh (data=2, model=2): batch-sharded cache +
+    head-sharded projections, token-exact vs the single-device
+    decoder."""
+    from defer_tpu.models.gpt import SpmdGptDecoder
+    from defer_tpu.parallel.mesh import make_mesh
+    from defer_tpu.parallel.transformer_stack import TransformerConfig
+
+    cfg = TransformerConfig(
+        num_layers=2, dim=32, num_heads=4, ffn_dim=64,
+        vocab_size=64, max_len=16, norm_style="pre",
+    )
+    ref = GptDecoder(cfg, compute_dtype=jnp.float32)
+    params = ref.init(jax.random.key(0))
+    mesh = make_mesh({"data": 2, "model": 2}, devices[:4])
+    dec = SpmdGptDecoder(
+        cfg, compute_dtype=jnp.float32, mesh=mesh, dp_axis="data"
+    )
+    tparams = dec.shard_params(params)
+    cache = dec.init_cache(4)  # batch 4 -> 2 per dp shard
+    assert {
+        s.data.shape for s in cache["k"].addressable_shards
+    } == {(2, 2, 2, 16, 8)}
+
+    ids = jax.random.randint(jax.random.key(1), (4, 6), 0, 64)
+    want = ref.reference_logits(params, ids)
+    step = dec.make_step(donate=False)
+    logits, cache = step(tparams, cache, ids[:, :4])
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(want[:, :4]), rtol=2e-4, atol=2e-4
+    )
+    logits, cache = step(tparams, cache, ids[:, 4:5])
+    np.testing.assert_allclose(
+        np.asarray(logits[:, 0]), np.asarray(want[:, 4]),
+        rtol=2e-4, atol=2e-4,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ref.generate(params, ids[:, :3], 4)),
+        np.asarray(dec.generate(tparams, ids[:, :3], 4)),
+    )
+
+
+def test_dp_axis_validated(devices):
+    from defer_tpu.models.gpt import SpmdGptDecoder
+    from defer_tpu.parallel.mesh import make_mesh
+    from defer_tpu.parallel.transformer_stack import TransformerConfig
+
+    cfg = TransformerConfig(
+        num_layers=2, dim=32, num_heads=4, ffn_dim=64,
+        vocab_size=64, max_len=16, norm_style="pre",
+    )
+    mesh = make_mesh({"model": 2}, devices[:2])
+    with pytest.raises(ValueError, match="not a mesh axis"):
+        SpmdGptDecoder(cfg, mesh=mesh, dp_axis="data")
+
+
+def test_dp_equals_tp_axis_rejected(devices):
+    from defer_tpu.models.gpt import SpmdGptDecoder
+    from defer_tpu.parallel.mesh import make_mesh
+    from defer_tpu.parallel.transformer_stack import TransformerConfig
+
+    cfg = TransformerConfig(
+        num_layers=2, dim=32, num_heads=4, ffn_dim=64,
+        vocab_size=64, max_len=16, norm_style="pre",
+    )
+    mesh = make_mesh({"model": 2}, devices[:2])
+    with pytest.raises(ValueError, match="must differ"):
+        SpmdGptDecoder(cfg, mesh=mesh, dp_axis="model")
