@@ -216,3 +216,54 @@ def test_dispatch_stage_forces_lossless_weights():
 def test_params_frames_reject_slash_in_param_name():
     with pytest.raises(GraphError, match="'/'"):
         params_to_frames({"node": {"a/b": np.zeros(2)}})
+
+
+def test_worker_truncated_dispatch_errors_cleanly():
+    """Peer closing mid-dispatch (after the manifest, before all weight
+    frames) must produce the diagnostic error, not PEP 479's opaque
+    'generator raised StopIteration'."""
+    from defer_tpu.graph.serialize import graph_to_json, params_to_frames
+    from defer_tpu.runtime.remote_stage import serve_stage
+    from defer_tpu.runtime.transport import ArraySender
+
+    g = residual_chain()
+    params = g.init(jax.random.key(0), (2, 8))
+    st0, _ = partition(g, ["add_1"])
+    sp = stage_params(params, st0)
+
+    port_box = {}
+    errors = []
+
+    def worker():
+        try:
+            serve_stage(
+                0,
+                "127.0.0.1",
+                1,  # never reached: dispatch fails first
+                listen_host="127.0.0.1",
+                accept_timeout_s=30.0,
+                announce=lambda p: port_box.setdefault("port", p),
+            )
+        except Exception as e:  # noqa: BLE001 — the assertion target
+            errors.append(e)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    deadline = 50
+    while "port" not in port_box and deadline:
+        threading.Event().wait(0.1)
+        deadline -= 1
+    snd = ArraySender("127.0.0.1", port_box["port"])
+    pairs = params_to_frames(sp)
+    snd.send(np.frombuffer(graph_to_json(st0).encode(), np.uint8))
+    snd.send(
+        np.frombuffer(
+            json.dumps([p for p, _ in pairs]).encode(), np.uint8
+        )
+    )
+    snd.send(np.asarray(pairs[0][1]))  # only 1 of N weight frames
+    snd.close()
+    t.join(timeout=60)
+    assert not t.is_alive()
+    assert errors, "worker should have errored on truncated dispatch"
+    assert "before the stage was fully dispatched" in str(errors[0])
