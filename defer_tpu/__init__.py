@@ -24,6 +24,13 @@ from defer_tpu.graph.partition import (
     stage_params,
     validate_cut_points,
 )
+from defer_tpu.graph.serialize import graph_from_json, graph_to_json
+from defer_tpu.parallel import (
+    Pipeline,
+    ReplicatedPipeline,
+    ShardedInference,
+    make_mesh,
+)
 
 __version__ = "0.1.0"
 
@@ -34,6 +41,12 @@ __all__ = [
     "GraphBuilder",
     "OpNode",
     "PartitionError",
+    "Pipeline",
+    "ReplicatedPipeline",
+    "ShardedInference",
+    "graph_from_json",
+    "graph_to_json",
+    "make_mesh",
     "partition",
     "run_local_inference",
     "stage_params",

@@ -250,9 +250,10 @@ def test_worker_truncated_dispatch_errors_cleanly():
     t = threading.Thread(target=worker, daemon=True)
     t.start()
     deadline = 50
-    while "port" not in port_box and deadline:
+    while "port" not in port_box and not errors and deadline:
         threading.Event().wait(0.1)
         deadline -= 1
+    assert not errors, f"worker failed before announcing: {errors[0]!r}"
     snd = ArraySender("127.0.0.1", port_box["port"])
     pairs = params_to_frames(sp)
     snd.send(np.frombuffer(graph_to_json(st0).encode(), np.uint8))
