@@ -223,6 +223,97 @@ def _measure(pipe, batch: int, target_s: float = 4.0) -> dict:
     )
 
 
+def bench_vit(devices) -> dict:
+    """Single-chip ViT-S/16 streamed-pipeline throughput + MFU (the
+    attention-era vision counterpart of the resnet50 headline)."""
+    import jax
+    import jax.numpy as jnp
+
+    from defer_tpu.config import DeferConfig
+    from defer_tpu.models import get_model
+    from defer_tpu.parallel.mesh import pipeline_devices
+    from defer_tpu.parallel.pipeline import Pipeline
+    from defer_tpu.utils.flops import graph_flops, peak_flops
+
+    model = get_model("vit_s16")
+    params = model.init(jax.random.key(0))
+    pipe = Pipeline(
+        [model.graph],
+        params,
+        pipeline_devices(1, devices[:1]),
+        DeferConfig(compute_dtype=jnp.bfloat16, max_inflight=64),
+    )
+    batch = 128
+    stats = _measure(pipe, batch)
+    fl = graph_flops(model.graph, params, (1, 224, 224, 3))
+    peak = peak_flops(devices[0].device_kind)
+    rec = {
+        "images_per_sec": round(stats["items_per_sec"], 1),
+        "batch": batch,
+        "mfu": round(stats["items_per_sec"] * fl / peak, 4) if peak else None,
+    }
+    log(f"vit-s16 single-chip: {rec}")
+    return rec
+
+
+def bench_gpt_decode(devices) -> dict:
+    """KV-cache decode: steady-state ms/token and tokens/sec for a
+    GPT-2-small-shaped decoder (batch 8)."""
+    import jax
+    import jax.numpy as jnp
+
+    from defer_tpu.models.gpt import GptDecoder
+    from defer_tpu.parallel.transformer_stack import TransformerConfig
+
+    cfg = TransformerConfig(
+        num_layers=12,
+        dim=768,
+        num_heads=12,
+        ffn_dim=3072,
+        vocab_size=32000,
+        max_len=512,
+        norm_style="pre",
+    )
+    from defer_tpu.models.gpt import sample_token
+
+    dec = GptDecoder(cfg, compute_dtype=jnp.bfloat16)
+    params = jax.device_put(dec.init(jax.random.key(0)), devices[0])
+    batch, prompt_len, steps = 8, 128, 64
+    step = dec.make_step()
+    ids = jax.random.randint(
+        jax.random.key(1), (batch, prompt_len), 0, cfg.vocab_size
+    )
+    # Warm both compiled shapes on a throwaway cache so the timings
+    # below measure compute, not XLA compilation.
+    warm_cache = dec.init_cache(batch)
+    wl, warm_cache = step(params, warm_cache, ids)
+    _, warm_cache = step(
+        params, warm_cache, jnp.zeros((batch, 1), ids.dtype)
+    )
+    jax.block_until_ready(wl)
+
+    rng = jax.random.key(2)
+    cache = dec.init_cache(batch)
+    t0 = time.perf_counter()
+    logits, cache = step(params, cache, ids)
+    logits.block_until_ready()
+    prefill_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        nxt, rng = sample_token(logits[:, -1:], rng, 0.0)
+        logits, cache = step(params, cache, nxt.astype(ids.dtype))
+    logits.block_until_ready()
+    per_tok = (time.perf_counter() - t0) / steps
+    rec = {
+        "ms_per_token": round(per_tok * 1e3, 3),
+        "tokens_per_sec": round(batch / per_tok, 1),
+        "batch": batch,
+        "prefill_s": round(prefill_s, 3),
+    }
+    log(f"gpt-small decode single-chip: {rec}")
+    return rec
+
+
 def bench_bert(devices) -> dict:
     """Single-chip SPMD BERT-base forward throughput + MFU."""
     import jax
@@ -393,6 +484,8 @@ def run_bench() -> dict:
         "multistage": None,
         "data_parallel": None,
         "bert_base": None,
+        "vit_s16": None,
+        "gpt_decode": None,
     }
     snapshot(result)
 
@@ -512,15 +605,19 @@ def run_bench() -> dict:
         result["vs_baseline"] = round(best_ips / north_star, 3)
     snapshot(result)
 
-    # BERT goes LAST: it is the newest section and the one that first
-    # exposed the wedged-transport hang; everything above is already
-    # snapshotted if it strikes again.
+    # Attention-era extras LAST (newest sections; the supervisor's
+    # snapshots protect everything above if one wedges).
     if not fast:
-        try:
-            result["bert_base"] = bench_bert(devices)
-        except Exception as e:  # noqa: BLE001 — extra datapoint only
-            log(f"bert probe failed ({type(e).__name__}: {e})")
-    snapshot(result)
+        for key, fn in (
+            ("vit_s16", bench_vit),
+            ("gpt_decode", bench_gpt_decode),
+            ("bert_base", bench_bert),
+        ):
+            try:
+                result[key] = fn(devices)
+            except Exception as e:  # noqa: BLE001 — extra datapoint only
+                log(f"{key} probe failed ({type(e).__name__}: {e})")
+            snapshot(result)
 
     return result
 
