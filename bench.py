@@ -286,11 +286,13 @@ def bench_gpt_decode(devices) -> dict:
     # Warm both compiled shapes on a throwaway cache so the timings
     # below measure compute, not XLA compilation.
     warm_cache = dec.init_cache(batch)
-    wl, warm_cache = step(params, warm_cache, ids)
+    _, warm_cache = step(params, warm_cache, ids)
     _, warm_cache = step(
         params, warm_cache, jnp.zeros((batch, 1), ids.dtype)
     )
-    jax.block_until_ready(wl)
+    # Block on the SECOND step's cache so no warm-up work is still
+    # queued when the prefill timer starts.
+    jax.block_until_ready(warm_cache)
 
     rng = jax.random.key(2)
     cache = dec.init_cache(batch)

@@ -26,6 +26,15 @@ the reference's nextNode message, src/dispatcher.py:54-58):
 
 Dispatcher side: `dispatch_stage(sender, stage, params)` then
 `send_activation(sender, x)` per microbatch.
+
+CHAIN ORDERING CONTRACT: a worker identifies the FIRST accepted
+connection as its dispatch stream, so chains must be dispatched
+tail-first (last stage's worker first) — each worker only connects to
+its --next peer after its own dispatch completes, which guarantees the
+downstream worker has already consumed its dispatch. Dispatching
+head-first lets an upstream worker's activation connection win the
+downstream accept race; the worker then fails fast with a GraphError
+naming this contract.
 """
 
 from __future__ import annotations
@@ -41,7 +50,11 @@ from defer_tpu.graph.serialize import (
     graph_to_json,
     params_to_frames,
 )
-from defer_tpu.runtime.transport import ArrayReceiver, ArraySender
+from defer_tpu.runtime.transport import (
+    ArrayReceiver,
+    ArraySender,
+    TransportError,
+)
 from defer_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -136,7 +149,16 @@ def serve_stage(
         announce(recv.port)
     it = iter(recv)
     try:
-        stage = graph_from_json(bytes(bytearray(next(it))).decode())
+        first = next(it)
+        try:
+            stage = graph_from_json(bytes(bytearray(first)).decode())
+        except Exception as e:  # noqa: BLE001 — re-raise with context
+            raise RuntimeError(
+                "first frame on the dispatch stream is not a stage "
+                "graph — if this worker is mid-chain, the chain was "
+                "probably dispatched head-first; dispatch tail-first "
+                "(see module docstring)"
+            ) from e
         manifest = json.loads(bytes(bytearray(next(it))).decode())
         # Explicit loop, not a generator fed to frames_to_params: a
         # StopIteration inside a generator becomes PEP 479's opaque
@@ -161,10 +183,41 @@ def serve_stage(
     )
     sender = ArraySender(next_host, next_port)
     count = 0
+    # Two session shapes (the reference used separate ports per role,
+    # src/node.py:18; here roles share the listen socket):
+    #   * single-peer: the dispatcher keeps streaming activations on
+    #     the dispatch connection (the simple two-process case);
+    #   * chained: the dispatch stream ENDS after the weights, and the
+    #     activation stream arrives as a SECOND connection from the
+    #     previous chain hop.
+    accepted_second = False
     try:
         while True:
-            acts = _read_bundle(it, n_in)
+            try:
+                acts = _read_bundle(it, n_in)
+            except TransportError:
+                if accepted_second and count == 0:
+                    # Dispatch-only session (dispatcher closed without
+                    # streaming and no chain hop ever connected): a
+                    # clean zero-work exit, not a failure.
+                    log.info(
+                        "remote stage %r: no activation peer arrived; "
+                        "dispatch-only session",
+                        stage.name,
+                    )
+                    return count
+                raise
             if acts is None:
+                if count == 0 and not accepted_second:
+                    log.info(
+                        "remote stage %r: dispatch stream closed; "
+                        "awaiting the activation peer",
+                        stage.name,
+                    )
+                    recv.next_peer()
+                    it = iter(recv)
+                    accepted_second = True
+                    continue
                 return count
             out = fn(params, acts if n_in > 1 else acts[0])
             outs = out if isinstance(out, tuple) else (out,)

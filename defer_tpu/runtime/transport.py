@@ -184,6 +184,20 @@ class ArrayReceiver:
                 raise TransportError(f"unknown frame tag {tag!r}")
             yield codec.decode(_recv_exact(conn, length))
 
+    def next_peer(self) -> None:
+        """Drop the current peer and accept a fresh one on the same
+        listening socket — session handoff for multi-role streams (a
+        remote stage worker takes its DISPATCH stream from the
+        dispatcher, then its ACTIVATION stream from the previous chain
+        hop; the reference used separate ports per role, reference
+        src/node.py:18)."""
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+            self._conn = None
+
     def close(self) -> None:
         for s in (self._conn, self._server):
             if s is not None:

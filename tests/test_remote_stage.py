@@ -268,3 +268,90 @@ def test_worker_truncated_dispatch_errors_cleanly():
     assert not t.is_alive()
     assert errors, "worker should have errored on truncated dispatch"
     assert "before the stage was fully dispatched" in str(errors[0])
+
+
+def test_three_process_two_worker_chain():
+    """The reference's full deployment shape: dispatcher + TWO compute
+    nodes chained by --next (reference src/dispatcher.py:54-58), each
+    in its own OS process. Each worker is dispatched its stage
+    directly; the downstream worker then takes its activation stream
+    as a second peer (session handoff, ArrayReceiver.next_peer)."""
+    import os
+
+    from defer_tpu.runtime.remote_stage import (
+        dispatch_stage,
+        recv_results,
+        send_activation,
+    )
+    from defer_tpu.runtime.transport import ArrayReceiver, ArraySender
+
+    g = residual_chain()
+    params = g.init(jax.random.key(0), (2, 8))
+    st0, st1, st2 = partition(g, ["add_1", "add_2"])
+
+    results = ArrayReceiver(0, host="127.0.0.1", accept_timeout_s=60.0)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+    def spawn(next_hop: str):
+        return subprocess.Popen(
+            [
+                sys.executable, "-m", "defer_tpu.runtime.remote_stage",
+                "--listen", "0", "--next", next_hop,
+            ],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=env,
+        )
+
+    w2 = spawn(f"127.0.0.1:{results.port}")
+    try:
+        line2 = w2.stdout.readline()
+        assert line2.startswith("LISTENING "), (line2, w2.stderr.read())
+        port2 = int(line2.split()[1])
+
+        # Dispatch w2 directly, then close: its activations will come
+        # from w1 as a second peer.
+        snd2 = ArraySender("127.0.0.1", port2)
+        dispatch_stage(snd2, st2, stage_params(params, st2))
+        snd2.close()
+
+        w1 = spawn(f"127.0.0.1:{port2}")
+        try:
+            line1 = w1.stdout.readline()
+            assert line1.startswith("LISTENING "), (line1, w1.stderr.read())
+            port1 = int(line1.split()[1])
+
+            snd1 = ArraySender("127.0.0.1", port1)
+            dispatch_stage(snd1, st1, stage_params(params, st1))
+
+            got = []
+            t = threading.Thread(
+                target=lambda: got.extend(recv_results(results)),
+                daemon=True,
+            )
+            t.start()
+
+            n = 4
+            p0 = stage_params(params, st0)
+            xs = [
+                np.random.default_rng(i).standard_normal((2, 8)).astype(
+                    np.float32
+                )
+                for i in range(n)
+            ]
+            for x in xs:
+                send_activation(snd1, st0.apply(p0, x))
+            snd1.close()
+            t.join(timeout=120)
+            assert not t.is_alive() and len(got) == n
+            for x, out in zip(xs, got):
+                np.testing.assert_allclose(
+                    out, np.asarray(g.apply(params, x)),
+                    rtol=1e-4, atol=1e-6,
+                )
+            assert w1.wait(timeout=60) == 0
+            assert w2.wait(timeout=60) == 0
+        finally:
+            w1.kill()
+    finally:
+        w2.kill()
+        results.close()
