@@ -101,43 +101,16 @@ def _clear_backends() -> None:
             pass
 
 
-class BackendInitHang(RuntimeError):
-    """Backend init exceeded its deadline (wedged transport) — distinct
-    from an ERROR raised by init, which is retryable."""
-
-
 def _want_cpu() -> bool:
     want = os.environ.get("JAX_PLATFORMS", "")
     return want.split(",")[0].strip() == "cpu" if want else False
 
 
-def _devices_with_deadline(timeout_s: float):
-    """jax.devices() bounded by a deadline: a wedged TPU tunnel HANGS
-    backend init rather than erroring, which would otherwise stall the
-    whole bench past the driver's timeout with no JSON line emitted."""
-    import threading
-
-    import jax
-
-    result: dict = {}
-
-    def probe() -> None:
-        try:
-            result["devs"] = jax.devices()
-        except BaseException as e:  # noqa: BLE001 — relayed below
-            result["err"] = e
-
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    t.join(timeout_s)
-    if t.is_alive():
-        raise BackendInitHang(
-            f"backend init did not complete within {timeout_s:.0f}s "
-            "(wedged TPU transport?)"
-        )
-    if "err" in result:
-        raise result["err"]
-    return result["devs"]
+# The supervisor half of this file must stay import-light: jax /
+# defer_tpu load only in functions the measurement CHILD reaches, so a
+# broken install still produces an error JSON line instead of a bare
+# import traceback. The bounded-init helpers live in
+# defer_tpu/utils/platform.py and are imported lazily below.
 
 
 def init_backend_with_retry(attempts: int = 3):
@@ -145,6 +118,11 @@ def init_backend_with_retry(attempts: int = 3):
     retry with backoff instead of surfacing a stack trace as the
     round's headline artifact."""
     import jax
+
+    from defer_tpu.utils.platform import (
+        BackendInitHang,
+        devices_with_deadline as _devices_with_deadline,
+    )
 
     want = os.environ.get("JAX_PLATFORMS", "")
     want_cpu = _want_cpu()

@@ -23,11 +23,19 @@ def cmd_info(args: argparse.Namespace) -> None:
     _init_platform()
     from defer_tpu.models import model_names
     from defer_tpu.ops.registry import op_names
-    from defer_tpu.parallel.mesh import describe_topology
+    from defer_tpu.utils.platform import BackendInitHang, devices_with_deadline
 
+    try:
+        devices_with_deadline(60.0)
+        from defer_tpu.parallel.mesh import describe_topology
+
+        topology: dict = describe_topology()
+    except BackendInitHang as e:
+        # A wedged device transport must not hang the CLI forever.
+        topology = {"error": str(e)}
     print(json.dumps(
         {
-            "topology": describe_topology(),
+            "topology": topology,
             "models": model_names(),
             "num_ops": len(op_names()),
         },

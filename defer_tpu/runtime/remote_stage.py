@@ -135,6 +135,7 @@ def serve_stage(
     *,
     listen_host: str = "0.0.0.0",
     accept_timeout_s: float = 120.0,
+    handoff_timeout_s: float = 15.0,
     announce=None,
 ) -> int:
     """Run one worker session to completion; returns microbatches
@@ -153,7 +154,9 @@ def serve_stage(
         try:
             stage = graph_from_json(bytes(bytearray(first)).decode())
         except Exception as e:  # noqa: BLE001 — re-raise with context
-            raise RuntimeError(
+            from defer_tpu.graph.ir import GraphError
+
+            raise GraphError(
                 "first frame on the dispatch stream is not a stage "
                 "graph — if this worker is mid-chain, the chain was "
                 "probably dispatched head-first; dispatch tail-first "
@@ -196,10 +199,16 @@ def serve_stage(
             try:
                 acts = _read_bundle(it, n_in)
             except TransportError:
-                if accepted_second and count == 0:
-                    # Dispatch-only session (dispatcher closed without
-                    # streaming and no chain hop ever connected): a
-                    # clean zero-work exit, not a failure.
+                if (
+                    accepted_second
+                    and count == 0
+                    and recv._conn is None
+                ):
+                    # The HANDOFF ACCEPT timed out with no peer ever
+                    # connecting: a dispatch-only session, clean
+                    # zero-work exit. (A peer that connected and died
+                    # mid-frame leaves recv._conn set — that is a real
+                    # failure and re-raises.)
                     log.info(
                         "remote stage %r: no activation peer arrived; "
                         "dispatch-only session",
@@ -211,10 +220,16 @@ def serve_stage(
                 if count == 0 and not accepted_second:
                     log.info(
                         "remote stage %r: dispatch stream closed; "
-                        "awaiting the activation peer",
+                        "awaiting the activation peer (<= %.0fs)",
                         stage.name,
+                        handoff_timeout_s,
                     )
                     recv.next_peer()
+                    # Bound the handoff wait separately: a dispatch-
+                    # only session should exit in seconds, not the
+                    # full accept timeout; chains must connect their
+                    # next hop within this budget.
+                    recv._server.settimeout(handoff_timeout_s)
                     it = iter(recv)
                     accepted_second = True
                     continue

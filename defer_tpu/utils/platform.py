@@ -20,3 +20,40 @@ def honor_env_platform() -> None:
         import jax
 
         jax.config.update("jax_platforms", want)
+
+
+class BackendInitHang(RuntimeError):
+    """Backend init exceeded its deadline (wedged device transport) —
+    distinct from an ERROR raised by init, which is retryable."""
+
+
+def devices_with_deadline(timeout_s: float):
+    """jax.devices() bounded by a deadline: a wedged TPU tunnel HANGS
+    backend init rather than erroring, which would otherwise stall any
+    entry point that touches the backend (bench headline, CLI info)
+    forever. NOTE: on timeout the probe thread remains blocked inside
+    xla_bridge holding its module lock — treat the process as unable
+    to use that backend and exit/fallback, don't retry in-process."""
+    import threading
+
+    import jax
+
+    result: dict = {}
+
+    def probe() -> None:
+        try:
+            result["devs"] = jax.devices()
+        except BaseException as e:  # noqa: BLE001 — relayed below
+            result["err"] = e
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        raise BackendInitHang(
+            f"backend init did not complete within {timeout_s:.0f}s "
+            "(wedged device transport?)"
+        )
+    if "err" in result:
+        raise result["err"]
+    return result["devs"]

@@ -355,3 +355,48 @@ def test_three_process_two_worker_chain():
     finally:
         w2.kill()
         results.close()
+
+
+def test_dispatch_only_session_exits_cleanly_and_fast():
+    """Dispatch + close with zero activations: the worker waits only
+    the short handoff budget for a phantom chain hop, then exits
+    cleanly with zero relayed microbatches."""
+    import time
+
+    from defer_tpu.runtime.remote_stage import dispatch_stage, serve_stage
+    from defer_tpu.runtime.transport import ArrayReceiver, ArraySender
+
+    g = residual_chain()
+    params = g.init(jax.random.key(0), (2, 8))
+    st0, _ = partition(g, ["add_1"])
+
+    sink = ArrayReceiver(0, host="127.0.0.1", accept_timeout_s=30.0)
+    port_box = {}
+    out_box = {}
+
+    def worker():
+        out_box["count"] = serve_stage(
+            0,
+            "127.0.0.1",
+            sink.port,
+            listen_host="127.0.0.1",
+            accept_timeout_s=30.0,
+            handoff_timeout_s=2.0,
+            announce=lambda p: port_box.setdefault("port", p),
+        )
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    deadline = 50
+    while "port" not in port_box and deadline:
+        threading.Event().wait(0.1)
+        deadline -= 1
+    snd = ArraySender("127.0.0.1", port_box["port"])
+    dispatch_stage(snd, st0, stage_params(params, st0))
+    t0 = time.monotonic()
+    snd.close()
+    t.join(timeout=30)
+    sink.close()
+    assert not t.is_alive()
+    assert out_box["count"] == 0
+    assert time.monotonic() - t0 < 10  # handoff budget, not 120s
