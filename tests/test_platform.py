@@ -1,0 +1,56 @@
+"""Shared bounded-backend-init helpers (utils/platform.py)."""
+
+import threading
+
+import jax
+import pytest
+
+from defer_tpu.utils.platform import (
+    BackendInitHang,
+    devices_with_deadline,
+    honor_env_platform,
+)
+
+
+def test_devices_with_deadline_passes_through():
+    devs = devices_with_deadline(30.0)
+    assert devs == jax.devices()
+
+
+def test_devices_with_deadline_raises_on_hang(monkeypatch):
+    """A backend whose init never returns must surface BackendInitHang
+    at the deadline, not block the caller forever."""
+    release = threading.Event()
+
+    def hang():
+        release.wait(30.0)
+        return []
+
+    monkeypatch.setattr(jax, "devices", hang)
+    try:
+        with pytest.raises(BackendInitHang, match="did not complete"):
+            devices_with_deadline(0.3)
+    finally:
+        release.set()  # unblock the probe thread promptly
+
+
+def test_devices_with_deadline_relays_init_errors(monkeypatch):
+    def boom():
+        raise RuntimeError("no backend for you")
+
+    monkeypatch.setattr(jax, "devices", boom)
+    with pytest.raises(RuntimeError, match="no backend for you"):
+        devices_with_deadline(5.0)
+
+
+def test_honor_env_platform(monkeypatch):
+    calls = []
+    monkeypatch.setattr(
+        jax.config, "update", lambda k, v: calls.append((k, v))
+    )
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    honor_env_platform()
+    assert calls == []
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    honor_env_platform()
+    assert calls == [("jax_platforms", "cpu")]
