@@ -106,6 +106,18 @@ def _want_cpu() -> bool:
     return want.split(",")[0].strip() == "cpu" if want else False
 
 
+def _is_init_error(err: str | None) -> bool:
+    """Did this attempt die before measuring anything, in backend init?
+    Those failures are process-local (a hung probe thread wedges only
+    its own process) — a fresh subprocess may reach the TPU."""
+    if not err:
+        return False
+    return any(
+        s in err
+        for s in ("BackendInitHang", "backend init", "requested platform")
+    )
+
+
 # The supervisor half of this file must stay import-light: jax /
 # defer_tpu load only in functions the measurement CHILD reaches, so a
 # broken install still produces an error JSON line instead of a bare
@@ -348,6 +360,54 @@ def bench_bert(devices) -> dict:
     return rec
 
 
+def bench_pallas_attention(devices) -> dict:
+    """Pallas flash attention vs the XLA attention path, long-sequence
+    causal self-attention. OPT-IN (DEFER_TPU_PALLAS=1): on this site's
+    tunneled axon backend a Mosaic compile hangs the transport, so the
+    kernel is gated off by default (ops/attention.py _pallas_available)
+    and this section only runs where the operator has declared the TPU
+    direct-attached. The supervisor's snapshots protect every earlier
+    section if the compile wedges anyway."""
+    import jax
+    import jax.numpy as jnp
+
+    from defer_tpu.ops.attention import multi_head_attention
+
+    b, s, h, dh = 4, 2048, 16, 64
+    keys = jax.random.split(jax.random.key(0), 3)
+    q, k, v = (
+        jax.random.normal(kk, (b, s, h * dh), jnp.bfloat16) for kk in keys
+    )
+
+    def timed(use_pallas: bool) -> float:
+        fn = jax.jit(
+            lambda q, k, v: multi_head_attention(
+                q, k, v, num_heads=h, causal=True, use_pallas=use_pallas
+            )
+        )
+        fn(q, k, v).block_until_ready()  # compile
+        iters = 20
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = fn(q, k, v)
+        out.block_until_ready()
+        return (time.perf_counter() - t0) / iters
+
+    t_pallas = timed(True)
+    t_xla = timed(False)
+    rec = {
+        "batch": b,
+        "seq_len": s,
+        "heads": h,
+        "pallas_ms": round(t_pallas * 1e3, 3),
+        "xla_ms": round(t_xla * 1e3, 3),
+        "speedup": round(t_xla / t_pallas, 3),
+    }
+    log(f"pallas flash attention: {rec}")
+    return rec
+
+
 def run_bench() -> dict:
     import jax
 
@@ -451,6 +511,9 @@ def run_bench() -> dict:
 
     # Headline is in hand — snapshot it before the optional sections so
     # a wedge in any of them can't cost the round its number.
+    # chip_seconds_per_1k_images is the TPU-native stand-in for the
+    # paper's per-node energy claim (reference README.md:12, -63%/node):
+    # total chip time burned per 1000 images, lower is better.
     result = {
         "metric": (
             f"resnet50_images_per_sec_pipeline_{n_stages}stage"
@@ -460,12 +523,15 @@ def run_bench() -> dict:
         "unit": "images/sec",
         "vs_baseline": None,
         "mfu": round(best_ips * flops_per_image / peak, 4) if peak else None,
+        "chip_seconds_per_1k_images": round(n_dev * 1000.0 / best_ips, 2),
         "platform": topo["backend"],
         "multistage": None,
         "data_parallel": None,
+        "stage_mfu": None,
         "bert_base": None,
         "vit_s16": None,
         "gpt_decode": None,
+        "pallas_attention": None,
     }
     snapshot(result)
 
@@ -507,6 +573,9 @@ def run_bench() -> dict:
                 )
                 result["value"] = round(dp_ips, 2)
                 result["mfu"] = result["data_parallel"]["mfu"]
+                result["chip_seconds_per_1k_images"] = round(
+                    n_dev * 1000.0 / dp_ips, 2
+                )
                 best_ips = dp_ips
         except Exception as e:  # noqa: BLE001 — extra datapoint only
             log(f"data-parallel probe failed ({type(e).__name__}: {e})")
@@ -529,19 +598,31 @@ def run_bench() -> dict:
         ]
         with trace():
             lat = pipe.probe_stage_latencies(
-                jnp.ones((best_batch, 224, 224, 3), jnp.bfloat16), iters=10
+                jnp.ones((best_batch, 224, 224, 3), jnp.bfloat16), iters=20
             )
+        stage_recs = []
         for r, fl in zip(lat, stage_fl):
             stage_mfu = (
                 fl / r["amortized_s"] / chip_peak if chip_peak else None
+            )
+            stage_recs.append(
+                {
+                    "stage": r["stage"],
+                    "amortized_ms": round(r["amortized_s"] * 1e3, 3),
+                    "mfu": round(stage_mfu, 4)
+                    if stage_mfu is not None
+                    else None,
+                }
             )
             log(
                 f"stage {r['stage']} amortized "
                 f"{r['amortized_s'] * 1e3:.2f} ms"
                 + (f" (mfu {stage_mfu:.3f})" if stage_mfu is not None else "")
                 + f" (sync p50 {r['p50_s'] * 1e3:.2f} ms "
-                f"p99 {r['p99_s'] * 1e3:.2f} ms) on {r['device']}"
+                f"max {r['max_s'] * 1e3:.2f} ms) on {r['device']}"
             )
+        result["stage_mfu"] = stage_recs
+        snapshot(result)
     except Exception as e:  # noqa: BLE001 — diagnostics only
         log(f"stage latency probe failed ({type(e).__name__}: {e})")
 
@@ -588,11 +669,23 @@ def run_bench() -> dict:
     # Attention-era extras LAST (newest sections; the supervisor's
     # snapshots protect everything above if one wedges).
     if not fast:
-        for key, fn in (
+        sections = [
             ("vit_s16", bench_vit),
             ("gpt_decode", bench_gpt_decode),
             ("bert_base", bench_bert),
-        ):
+        ]
+        # Mosaic-kernel section last. It runs wherever the pallas gate
+        # answers yes: automatically on a direct-attached TPU, or
+        # forced by DEFER_TPU_PALLAS=1 — note that forcing ALSO flips
+        # the earlier transformer sections' use_pallas='auto' to the
+        # pallas kernels, so on a tunneled backend the env var risks
+        # every transformer number, not just this section; the
+        # supervisor's per-section snapshots are the containment.
+        from defer_tpu.ops.attention import _pallas_available
+
+        if _pallas_available():
+            sections.append(("pallas_attention", bench_pallas_attention))
+        for key, fn in sections:
             try:
                 result[key] = fn(devices)
             except Exception as e:  # noqa: BLE001 — extra datapoint only
@@ -602,7 +695,7 @@ def run_bench() -> dict:
     return result
 
 
-def cpu_fallback(err: str) -> dict | None:
+def cpu_fallback(err: str, timeout_s: float = 1200.0) -> dict | None:
     """When the TPU is unreachable, measure on CPU in a fresh bounded
     subprocess (this process's backend state may be wedged) so the
     round still records a real number — clearly marked platform=cpu
@@ -621,7 +714,7 @@ def cpu_fallback(err: str) -> dict | None:
             text=True,
             env=env,
             cwd=os.path.dirname(os.path.abspath(__file__)),
-            timeout=1200,
+            timeout=max(240.0, timeout_s),
         )
         line = out.stdout.strip().splitlines()[-1]
         result = json.loads(line)
@@ -634,17 +727,21 @@ def cpu_fallback(err: str) -> dict | None:
 
 def supervise(
     cmd: list[str] | None = None,
+    total_s: float | None = None,
 ) -> tuple[dict | None, str | None]:
     """Run the measurement in a child process under two deadlines.
 
     Returns (result, error): result is the child's final JSON on clean
     exit, else its last snapshot (with a `truncated` note) if that
     already carries a headline number; error describes what went wrong
-    (None on clean success). `cmd` overrides the child command (tests).
+    (None on clean success). `cmd` overrides the child command (tests);
+    `total_s` overrides this attempt's wall-clock deadline (main()'s
+    TPU-reacquisition loop shrinks it as the round budget drains).
     """
     import tempfile
 
-    total_s = float(os.environ.get("DEFER_BENCH_DEADLINE_S", "1500"))
+    if total_s is None:
+        total_s = float(os.environ.get("DEFER_BENCH_DEADLINE_S", "1500"))
     stall_s = float(os.environ.get("DEFER_BENCH_STALL_S", "660"))
     fd, snap_path = tempfile.mkstemp(prefix="defer_bench_", suffix=".jsonl")
     os.close(fd)
@@ -782,13 +879,48 @@ def main() -> None:
         print(json.dumps(result), flush=True)
         return
 
-    result, err = supervise()
+    # TPU-reacquisition loop: a wedged backend init is IN-PROCESS-fatal
+    # only — a fresh measurement child can retry safely. Spend the
+    # round's budget on fresh attempts (each burns up to ~180s probing
+    # init) and only then fall back to CPU, keeping enough in reserve
+    # for the fallback measurement itself.
+    t0 = time.monotonic()
+    budget_s = float(os.environ.get("DEFER_BENCH_DEADLINE_S", "1500"))
+    # Reserve budget for the CPU fallback only when that fallback can
+    # actually run — otherwise the measurement attempt gets every
+    # second of the deadline, as before.
+    can_fall_back = (
+        os.environ.get("DEFER_BENCH_NO_FALLBACK") != "1" and not _want_cpu()
+    )
+    reserve_s = (
+        float(os.environ.get("DEFER_BENCH_CPU_RESERVE_S", "250"))
+        if can_fall_back
+        else 0.0
+    )
+    attempt = 0
+    result = err = None
+    while True:
+        attempt += 1
+        remaining = budget_s - (time.monotonic() - t0)
+        if attempt > 1 and remaining < reserve_s + 210.0:
+            log(
+                f"supervisor: only {remaining:.0f}s of budget left; "
+                "stopping TPU attempts"
+            )
+            break
+        result, err = supervise(total_s=max(60.0, remaining - reserve_s))
+        if result is not None or _want_cpu() or not _is_init_error(err):
+            break
+        pause = min(30.0, 5.0 * attempt)
+        log(
+            f"supervisor: attempt {attempt} lost to backend init "
+            f"({err}); retrying in a fresh subprocess in {pause:.0f}s"
+        )
+        time.sleep(pause)
     if result is None:
-        if (
-            os.environ.get("DEFER_BENCH_NO_FALLBACK") != "1"
-            and not _want_cpu()
-        ):
-            result = cpu_fallback(err or "unknown failure")
+        if can_fall_back:
+            remaining = budget_s - (time.monotonic() - t0)
+            result = cpu_fallback(err or "unknown failure", remaining)
         if result is None:
             result = {
                 "metric": "resnet50_images_per_sec",

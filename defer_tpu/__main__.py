@@ -125,6 +125,10 @@ def cmd_serve_stage(args: argparse.Namespace) -> None:
     argv = ["--listen", str(args.listen), "--next", args.next]
     if args.accept_timeout is not None:
         argv += ["--accept-timeout", str(args.accept_timeout)]
+    if args.handoff_timeout is not None:
+        argv += ["--handoff-timeout", str(args.handoff_timeout)]
+    if args.expect_peer:
+        argv += ["--expect-peer"]
     serve_main(argv)
 
 
@@ -161,6 +165,13 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--listen", type=int, default=5000)
     p.add_argument("--next", required=True)
     p.add_argument("--accept-timeout", type=float, default=None)
+    p.add_argument("--handoff-timeout", type=float, default=None)
+    p.add_argument(
+        "--expect-peer",
+        action="store_true",
+        help="mid-chain worker: a missing upstream activation peer is "
+        "a hard error, not a clean zero-work exit",
+    )
 
     args = ap.parse_args(argv)
     {

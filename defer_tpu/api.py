@@ -129,7 +129,11 @@ class DEFER:
                 if isinstance(model, Model) and model.cut_candidates
                 else chain_boundaries(graph)
             )
-            n_stages = min(n_dev, len(cands) + 1)
+            # Each replica needs its own stage slots: claiming all
+            # n_dev for one replica's stages would make _compile wrap
+            # further replicas round-robin onto the SAME chips —
+            # contention, not throughput.
+            n_stages = min(max(1, n_dev // max(1, replicas)), len(cands) + 1)
             if example is None:
                 raise ValueError(
                     'partition_layers="auto" needs a Model (a raw Graph '
@@ -162,6 +166,15 @@ class DEFER:
         device_pool: Sequence[jax.Device] | None,
     ) -> Pipeline:
         pool = device_pool if device_pool is not None else self.devices
+        n_phys = len(pool if pool is not None else jax.devices())
+        if len(stages) * replicas > n_phys:
+            log.warning(
+                "%d stages x %d replicas oversubscribes %d physical "
+                "devices; replicas will share chips",
+                len(stages),
+                replicas,
+                n_phys,
+            )
         if replicas > 1:
             from defer_tpu.parallel.data_parallel import ReplicatedPipeline
 

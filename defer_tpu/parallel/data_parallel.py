@@ -68,8 +68,22 @@ class ReplicaRetirer:
         depth: int,
         sync: Any = hard_sync,
     ):
-        # Per-replica depth: total in-flight stays ~depth overall.
+        # Per-replica depth: total in-flight stays within the caller's
+        # max_inflight bound (it may cap activation residency, so never
+        # exceed it) — but a depth-1 Retirer blocks on its windowed
+        # barrier at every add(), so warn that the bank degrades to
+        # synchronous per-item dispatch when the window is too small.
         per = max(1, depth // num_replicas)
+        if per < 2:
+            log.warning(
+                "max_inflight=%d gives %d replicas a per-replica window "
+                "of 1: dispatch degrades to synchronous per-item "
+                "round-trips; set max_inflight >= %d to restore "
+                "pipelining",
+                depth,
+                num_replicas,
+                2 * num_replicas,
+            )
         self.retirers = [Retirer(per, sync) for _ in range(num_replicas)]
         self._ready: list[list[Any]] = [[] for _ in range(num_replicas)]
         self._add_at = 0

@@ -203,10 +203,12 @@ class Pipeline(StreamMeasure):
 
     def probe_stage_latencies(
         self, x: Any, iters: int = 10
-    ) -> list[dict[str, float]]:
-        """Per-stage p50/p99 latency in seconds, measured synchronously
+    ) -> list[dict[str, Any]]:
+        """Per-stage latency in seconds, measured synchronously
         (BASELINE.json's metric asks for per-stage p50). Run outside the
-        streaming loop so probing doesn't break overlap."""
+        streaming loop so probing doesn't break overlap. `p99_s` is only
+        reported when iters >= 100 — below that the 99th percentile of
+        the sample IS its max, so `max_s` carries it honestly instead."""
         h = self._place(x, self.devices[0])
         results = []
         for i, (fn, p) in enumerate(zip(self._plain_fns, self.stage_params)):
@@ -233,7 +235,12 @@ class Pipeline(StreamMeasure):
                     "stage": i,
                     "device": str(self.devices[i]),
                     "p50_s": times[len(times) // 2],
-                    "p99_s": times[min(len(times) - 1, int(len(times) * 0.99))],
+                    "p99_s": (
+                        times[int(len(times) * 0.99)]
+                        if len(times) >= 100
+                        else None
+                    ),
+                    "max_s": times[-1],
                     "min_s": times[0],
                     "amortized_s": amortized,
                 }

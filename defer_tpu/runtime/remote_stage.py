@@ -135,12 +135,19 @@ def serve_stage(
     *,
     listen_host: str = "0.0.0.0",
     accept_timeout_s: float = 120.0,
-    handoff_timeout_s: float = 15.0,
+    handoff_timeout_s: float = 60.0,
+    expect_activation_peer: bool = False,
     announce=None,
 ) -> int:
     """Run one worker session to completion; returns microbatches
     relayed. `announce(port)` is called once the listen socket is bound
-    (drivers/tests use it to learn an ephemeral port)."""
+    (drivers/tests use it to learn an ephemeral port).
+
+    ``expect_activation_peer=True`` declares this worker mid-chain: an
+    upstream hop WILL connect, so a handoff-accept timeout is a hard
+    error instead of a clean zero-work exit — without it a slow
+    upstream start (cold Python+JAX easily takes seconds) would make
+    the chain silently produce zero results with rc=0."""
     import jax
 
     recv = ArrayReceiver(
@@ -205,10 +212,17 @@ def serve_stage(
                     and recv._conn is None
                 ):
                     # The HANDOFF ACCEPT timed out with no peer ever
-                    # connecting: a dispatch-only session, clean
-                    # zero-work exit. (A peer that connected and died
+                    # connecting. (A peer that connected and died
                     # mid-frame leaves recv._conn set — that is a real
                     # failure and re-raises.)
+                    if expect_activation_peer:
+                        raise RuntimeError(
+                            f"remote stage {stage.name!r}: expected an "
+                            f"upstream activation peer but none "
+                            f"connected within {handoff_timeout_s:.0f}s"
+                        ) from None
+                    # Not declared mid-chain: a dispatch-only session,
+                    # clean zero-work exit.
                     log.info(
                         "remote stage %r: no activation peer arrived; "
                         "dispatch-only session",
@@ -257,6 +271,13 @@ def main(argv: list[str] | None = None) -> None:
         "--next", required=True, help="host:port of the next chain hop"
     )
     ap.add_argument("--accept-timeout", type=float, default=120.0)
+    ap.add_argument("--handoff-timeout", type=float, default=60.0)
+    ap.add_argument(
+        "--expect-peer",
+        action="store_true",
+        help="this worker is mid-chain: treat a missing upstream "
+        "activation peer as a hard error, never a clean zero-work exit",
+    )
     args = ap.parse_args(argv)
     host, _, port = args.next.rpartition(":")
     n = serve_stage(
@@ -264,6 +285,8 @@ def main(argv: list[str] | None = None) -> None:
         host or "127.0.0.1",
         int(port),
         accept_timeout_s=args.accept_timeout,
+        handoff_timeout_s=args.handoff_timeout,
+        expect_activation_peer=args.expect_peer,
         announce=lambda p: print(f"LISTENING {p}", flush=True),
     )
     print(f"DONE {n}", flush=True)

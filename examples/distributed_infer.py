@@ -129,7 +129,7 @@ def main() -> None:
             for r in defer.last_stage_latencies:
                 print(
                     f"  stage {r['stage']}: p50 {r['p50_s'] * 1e3:.2f} ms "
-                    f"p99 {r['p99_s'] * 1e3:.2f} ms"
+                    f"max {r['max_s'] * 1e3:.2f} ms"
                 )
 
     a = threading.Thread(

@@ -1,0 +1,42 @@
+"""Smoke-test the driver entry `dryrun_multichip` exactly the way the
+driver invokes it: a fresh interpreter, a hard external timeout, and
+only stdout to judge by. Guards against the default tier regressing
+past the driver's budget (VERDICT r03: rc=124 three rounds running).
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_dryrun_default_tier_under_driver_budget():
+    env = dict(os.environ)
+    env.pop("DEFER_DRYRUN_FULL", None)
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "import sys; sys.path.insert(0, %r); "
+            "import __graft_entry__ as g; g.dryrun_multichip(8)" % REPO,
+        ],
+        env=env,
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=150,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    # Per-section progress lines must reach stdout (a driver timeout
+    # still leaves evidence of how far the run got).
+    for section in (
+        "spmd",
+        "train-dp-pp-tp",
+        "hetero-pipeline",
+        "data-parallel",
+        "tp-decode",
+        "bundle",
+    ):
+        assert f"[dryrun] {section} ok" in proc.stdout, proc.stdout
+    assert "dryrun_multichip OK" in proc.stdout, proc.stdout

@@ -292,17 +292,19 @@ def test_three_process_two_worker_chain():
     results = ArrayReceiver(0, host="127.0.0.1", accept_timeout_s=60.0)
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
 
-    def spawn(next_hop: str):
+    def spawn(next_hop: str, *extra: str):
         return subprocess.Popen(
             [
                 sys.executable, "-m", "defer_tpu.runtime.remote_stage",
-                "--listen", "0", "--next", next_hop,
+                "--listen", "0", "--next", next_hop, *extra,
             ],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
             text=True, env=env,
         )
 
-    w2 = spawn(f"127.0.0.1:{results.port}")
+    # w2 is mid-chain: --expect-peer makes a missing upstream hop a
+    # hard error instead of a silent DONE 0.
+    w2 = spawn(f"127.0.0.1:{results.port}", "--expect-peer")
     try:
         line2 = w2.stdout.readline()
         assert line2.startswith("LISTENING "), (line2, w2.stderr.read())
@@ -400,3 +402,49 @@ def test_dispatch_only_session_exits_cleanly_and_fast():
     assert not t.is_alive()
     assert out_box["count"] == 0
     assert time.monotonic() - t0 < 10  # handoff budget, not 120s
+
+
+def test_expected_peer_missing_is_hard_error():
+    """A worker declared mid-chain (expect_activation_peer=True) whose
+    upstream hop never connects must FAIL, not exit cleanly with zero
+    work — the dispatcher cannot otherwise tell a dead chain from a
+    successful empty one (ADVICE r03)."""
+    from defer_tpu.runtime.remote_stage import dispatch_stage, serve_stage
+    from defer_tpu.runtime.transport import ArrayReceiver, ArraySender
+
+    g = residual_chain()
+    params = g.init(jax.random.key(0), (2, 8))
+    st0, _ = partition(g, ["add_1"])
+
+    sink = ArrayReceiver(0, host="127.0.0.1", accept_timeout_s=30.0)
+    port_box = {}
+    err_box = {}
+
+    def worker():
+        try:
+            serve_stage(
+                0,
+                "127.0.0.1",
+                sink.port,
+                listen_host="127.0.0.1",
+                accept_timeout_s=30.0,
+                handoff_timeout_s=2.0,
+                expect_activation_peer=True,
+                announce=lambda p: port_box.setdefault("port", p),
+            )
+        except RuntimeError as e:
+            err_box["err"] = e
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    deadline = 50
+    while "port" not in port_box and deadline:
+        threading.Event().wait(0.1)
+        deadline -= 1
+    snd = ArraySender("127.0.0.1", port_box["port"])
+    dispatch_stage(snd, st0, stage_params(params, st0))
+    snd.close()
+    t.join(timeout=30)
+    sink.close()
+    assert not t.is_alive()
+    assert "expected an upstream activation peer" in str(err_box["err"])
