@@ -127,10 +127,12 @@ def load_image_dir(
     path: str,
     *,
     extensions: Sequence[str] = (".png", ".jpg", ".jpeg", ".bmp"),
-) -> Iterator[np.ndarray]:
+    with_names: bool = False,
+) -> Iterator[Any]:
     """Decode every image in a directory (sorted order) to uint8 HWC
     RGB numpy arrays — the reference's PIL input path (reference
-    src/test.py:13-16) as a stream instead of one hard-coded file."""
+    src/test.py:13-16) as a stream instead of one hard-coded file.
+    with_names=True yields (filename, array) pairs instead."""
     import os
 
     from PIL import Image
@@ -143,7 +145,8 @@ def load_image_dir(
         raise FileNotFoundError(f"no images with {extensions} under {path!r}")
     for name in names:
         with Image.open(os.path.join(path, name)) as im:
-            yield np.asarray(im.convert("RGB"))
+            arr = np.asarray(im.convert("RGB"))
+        yield (name, arr) if with_names else arr
 
 
 def batched(

@@ -89,9 +89,34 @@ def main() -> None:
         action="store_true",
         help="feed jnp.ones instead of decoding real images",
     )
+    ap.add_argument(
+        "--weights",
+        default=None,
+        help='real checkpoint for the pipeline: "imagenet", "random", '
+        "or a Keras save_weights .h5 path (default: fresh init, as the "
+        "throughput numbers don't depend on the values)",
+    )
     args = ap.parse_args()
 
     model = get_model(args.model)
+    params = None
+    if args.weights:
+        from defer_tpu.models.pretrained import (
+            PretrainedUnavailable,
+            load_pretrained,
+        )
+
+        from defer_tpu.models.transplant import TransplantError
+
+        try:
+            model, params, _ = load_pretrained(args.model, args.weights)
+            print(f"{args.model}: weights from {args.weights}")
+        except PretrainedUnavailable as e:
+            print(f"pretrained weights unavailable ({e}); using fresh init")
+        except TransplantError as e:
+            raise SystemExit(
+                f"checkpoint did not match the {args.model} graph: {e}"
+            ) from e
     n_dev = len(jax.devices())
     if args.cuts == "auto":
         cuts = "auto"
@@ -134,6 +159,7 @@ def main() -> None:
 
     a = threading.Thread(
         target=defer.run_defer, args=(model, cuts, input_q, output_q),
+        kwargs={"params": params},
         daemon=True,
     )
     b = threading.Thread(target=print_result, args=(output_q,))

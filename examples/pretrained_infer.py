@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Pretrained-weights end-to-end demo: real Keras ResNet50 checkpoint
+-> transplant -> classify real images -> single-device and pipelined
+runs must agree on top-1 (reference src/local_infer.py:8-23).
+
+    python examples/pretrained_infer.py                    # imagenet (cache/net)
+    python examples/pretrained_infer.py --weights PATH.h5  # local checkpoint
+    python examples/pretrained_infer.py --weights random   # offline: real
+        tf.keras model with fresh weights; still proves the transplant
+        numerically by cross-checking against TF's own forward.
+
+With no network, no ~/.keras cache and no --weights, the demo SKIPS
+cleanly (exit 0, "SKIP" line) instead of half-running.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import argparse
+import queue
+import threading
+
+import numpy as np
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", default="resnet50")
+    ap.add_argument(
+        "--weights",
+        default="imagenet",
+        help='"imagenet", "random", or a Keras save_weights .h5 path',
+    )
+    ap.add_argument(
+        "--images",
+        default=os.path.join(os.path.dirname(__file__), "images"),
+    )
+    ap.add_argument("--stages", type=int, default=2)
+    ap.add_argument(
+        "--model-json",
+        default=None,
+        help="model.to_json() text file — required to resolve layer "
+        "names in Keras 3 .weights.h5 checkpoints",
+    )
+    args = ap.parse_args()
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    from defer_tpu.models.pretrained import (
+        PretrainedUnavailable,
+        load_pretrained,
+    )
+    from defer_tpu.models.transplant import TransplantError
+
+    try:
+        model, params, tf_model = load_pretrained(
+            args.model, args.weights, model_json=args.model_json
+        )
+    except PretrainedUnavailable as e:
+        print(f"SKIP: pretrained weights unavailable ({e})")
+        return 0
+    except TransplantError as e:
+        print(
+            f"ERROR: checkpoint did not match the {args.model} graph "
+            f"({e}). Keras 3 .weights.h5 files need --model-json "
+            "<file containing model.to_json()>."
+        )
+        return 2
+
+    from defer_tpu.runtime.data import (
+        imagenet_preprocess,
+        load_image_dir,
+        preprocess_mode,
+    )
+
+    names, imgs = [], []
+    for fname, arr in load_image_dir(args.images, with_names=True):
+        names.append(fname)
+        imgs.append(arr)
+    if not imgs:
+        print(f"SKIP: no images in {args.images}")
+        return 0
+    # imagenet_preprocess returns NHWC; one image in -> (1,H,W,C) out.
+    batch = np.concatenate(
+        [
+            imagenet_preprocess(
+                a,
+                size=model.input_shape[0],
+                mode=preprocess_mode(model.name),
+                out_dtype=np.float32,
+            )
+            for a in imgs
+        ]
+    )
+
+    # 1. Single-device forward.
+    y_single = np.asarray(model.graph.apply(params, batch))
+    top1_single = y_single.argmax(-1)
+
+    # 2. The same params streamed through the distributed pipeline
+    #    (queue-in/queue-out contract, reference src/test.py:30-41).
+    from defer_tpu.api import DEFER
+
+    defer = DEFER()
+    cuts = model.default_cuts(args.stages)
+    inq: queue.Queue = queue.Queue()
+    outq: queue.Queue = queue.Queue()
+    t = threading.Thread(
+        target=defer.run_defer,
+        args=(model, cuts, inq, outq),
+        kwargs={"params": params},
+        daemon=True,
+    )
+    t.start()
+    inq.put(batch)
+    inq.put(None)
+    y_pipe = np.asarray(outq.get(timeout=600))
+    t.join(timeout=120)
+    top1_pipe = y_pipe.argmax(-1)
+
+    assert (top1_single == top1_pipe).all(), (
+        f"top-1 disagreement: single {top1_single} vs pipeline {top1_pipe}"
+    )
+
+    # 3. Cross-check against tf.keras' own forward when it is live.
+    if tf_model is not None:
+        y_tf = np.asarray(tf_model(batch, training=False))
+        top1_tf = y_tf.argmax(-1)
+        assert (top1_single == top1_tf).all(), (
+            f"top-1 disagreement vs tf.keras: {top1_single} vs {top1_tf}"
+        )
+
+    labels = _imagenet_labels()
+    for n, idx, p in zip(names, top1_single, y_single.max(-1)):
+        label = labels[idx] if labels else f"class {idx}"
+        print(f"{n}: top-1 {label} (index {idx}, p={p:.3f})")
+    agree = "single==pipeline" + ("==tf.keras" if tf_model is not None else "")
+    print(
+        f"OK: {len(names)} images, {len(cuts) + 1}-stage pipeline, "
+        f"top-1 agreement {agree}"
+    )
+    return 0
+
+
+def _imagenet_labels() -> list[str] | None:
+    """Class names if keras' imagenet_class_index.json is cached
+    locally; None offline (indices are printed instead)."""
+    try:
+        from tensorflow.keras.applications.imagenet_utils import (
+            decode_predictions,
+        )
+
+        one_hot = np.zeros((1, 1000), np.float32)
+        one_hot[0, 0] = 1.0
+        decode_predictions(one_hot, top=1)  # trigger the index load
+        from tensorflow.keras.applications import imagenet_utils
+
+        index = imagenet_utils.CLASS_INDEX
+        return [index[str(i)][1] for i in range(1000)]
+    except Exception:  # noqa: BLE001 — offline / no TF
+        return None
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

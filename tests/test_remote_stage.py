@@ -12,6 +12,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from defer_tpu.graph.ir import GraphError
 from defer_tpu.graph.partition import partition, stage_params
 from defer_tpu.graph.serialize import (

@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from defer_tpu.models.vit import SpmdVit
 from defer_tpu.parallel.mesh import make_mesh
@@ -11,6 +12,8 @@ from defer_tpu.parallel.transformer_stack import (
     init_stack,
     layers_apply,
 )
+
+pytestmark = pytest.mark.slow
 
 
 def _cfg(**kw):
