@@ -34,7 +34,10 @@ from jax import lax
 from defer_tpu.parallel.transformer_stack import (
     TransformerConfig,
     _layer_norm,
+    _rms_norm,
+    apply_rope,
     init_stack,
+    norm_apply,
 )
 
 
@@ -76,24 +79,31 @@ class GptDecoder:
     def init(self, rng: jax.Array) -> dict:
         cfg = self.cfg
         k_embed, k_stack, k_ln = jax.random.split(rng, 3)
-        return {
+        p = {
             "token_embedding": jax.random.normal(
                 k_embed, (cfg.vocab_size, cfg.dim)
             )
             * 0.02,
-            "pos_embedding": jax.random.normal(
-                jax.random.fold_in(k_embed, 1), (cfg.max_len, cfg.dim)
-            )
-            * 0.02,
             "final_ln_scale": jnp.ones((cfg.dim,)),
-            "final_ln_bias": jnp.zeros((cfg.dim,)),
             "stack": init_stack(k_stack, cfg),
         }
+        if cfg.pos_style == "learned":
+            p["pos_embedding"] = (
+                jax.random.normal(
+                    jax.random.fold_in(k_embed, 1), (cfg.max_len, cfg.dim)
+                )
+                * 0.02
+            )
+        if cfg.norm_type == "layer":
+            p["final_ln_bias"] = jnp.zeros((cfg.dim,))
+        return p
 
     def init_cache(self, batch: int) -> dict:
         cfg = self.cfg
         dh = cfg.dim // cfg.num_heads
-        shape = (cfg.num_layers, batch, cfg.num_heads, cfg.max_len, dh)
+        # GQA caches store KV heads only — the architecture's memory
+        # win: cache bytes scale with kv_heads, not num_heads.
+        shape = (cfg.num_layers, batch, cfg.kv_heads, cfg.max_len, dh)
         return {
             "k": jnp.zeros(shape, self.compute_dtype),
             "v": jnp.zeros(shape, self.compute_dtype),
@@ -114,23 +124,41 @@ class GptDecoder:
         (out, new_k, new_v). Under shard_map with tp_axis set, the
         projections arrive column-sharded (this shard's head group),
         the caches hold only local heads, and wo/w2 are row-sharded
-        with psum — the Megatron pattern on the decode path."""
+        with psum — the Megatron pattern on the decode path.
+
+        GQA attends grouped: q reshapes to [B, Hkv, G, T, Dh] against
+        the [B, Hkv, S, Dh] cache, so the shared KV head is READ once
+        per group instead of materialized G times — decode is KV-cache
+        bandwidth bound, which is the whole point of GQA."""
         cfg = self.cfg
         dt = x.dtype
-        h = _layer_norm(x, p["ln1_scale"], p["ln1_bias"], cfg.layer_norm_eps)
-        q = self._split_heads(h @ p["wq"].astype(dt) + p["bq"].astype(dt))
-        k = self._split_heads(h @ p["wk"].astype(dt) + p["bk"].astype(dt))
-        v = self._split_heads(h @ p["wv"].astype(dt) + p["bv"].astype(dt))
+        dh = cfg.dim // cfg.num_heads
+
+        def bias(h, name):
+            return h + p[name].astype(dt) if name in p else h
+
+        h = norm_apply(cfg, x, p, "ln1")
+        qf = bias(h @ p["wq"].astype(dt), "bq")
+        kf = bias(h @ p["wk"].astype(dt), "bk")
+        vf = bias(h @ p["wv"].astype(dt), "bv")
+        if cfg.pos_style == "rope":
+            positions = pos + jnp.arange(qf.shape[1])
+            qf = apply_rope(qf, dh, positions, cfg.rope_theta)
+            kf = apply_rope(kf, dh, positions, cfg.rope_theta)
+        q = self._split_heads(qf)
+        k = self._split_heads(kf)
+        v = self._split_heads(vf)
         # Write the T new K/V rows at the cache head.
         k_cache = lax.dynamic_update_slice(k_cache, k, (0, 0, pos, 0))
         v_cache = lax.dynamic_update_slice(v_cache, v, (0, 0, pos, 0))
 
-        t = q.shape[2]
+        b, h_q, t, _ = q.shape
+        hkv = k_cache.shape[1]
         s_max = k_cache.shape[2]
-        dh = q.shape[-1]
+        qg = q.reshape(b, hkv, h_q // hkv, t, dh)
         logits = jnp.einsum(
-            "bhtd,bhsd->bhts",
-            q,
+            "bkgtd,bksd->bkgts",
+            qg,
             k_cache,
             preferred_element_type=jnp.float32,
         ) * (dh**-0.5)
@@ -141,21 +169,27 @@ class GptDecoder:
         tt = pos + jnp.arange(t)[:, None]
         logits = jnp.where(j <= tt, logits, -jnp.inf)
         weights = jax.nn.softmax(logits, axis=-1).astype(dt)
-        attn = jnp.einsum("bhts,bhsd->bhtd", weights, v_cache)
-        b, h_local = attn.shape[0], attn.shape[1]
-        attn = attn.transpose(0, 2, 1, 3).reshape(b, t, h_local * dh)
+        attn = jnp.einsum("bkgts,bksd->bkgtd", weights, v_cache)
+        attn = attn.reshape(b, h_q, t, dh)
+        attn = attn.transpose(0, 2, 1, 3).reshape(b, t, h_q * dh)
         attn = attn @ p["wo"].astype(dt)
         if tp_axis is not None:
             attn = lax.psum(attn, tp_axis)
-        attn = attn + p["bo"].astype(dt)
+        attn = bias(attn, "bo")
         x = x + attn
-        h2 = _layer_norm(x, p["ln2_scale"], p["ln2_bias"], cfg.layer_norm_eps)
-        ff = h2 @ p["w1"].astype(dt) + p["b1"].astype(dt)
+        h2 = norm_apply(cfg, x, p, "ln2")
+        if cfg.ffn_style == "swiglu":
+            gate = jax.nn.silu(h2 @ p["w1"].astype(dt))
+            ff = (gate * (h2 @ p["w3"].astype(dt))) @ p["w2"].astype(dt)
+            if tp_axis is not None:
+                ff = lax.psum(ff, tp_axis)
+            return x + ff, k_cache, v_cache
+        ff = bias(h2 @ p["w1"].astype(dt), "b1")
         ff = jax.nn.gelu(ff)
         ff = ff @ p["w2"].astype(dt)
         if tp_axis is not None:
             ff = lax.psum(ff, tp_axis)
-        return x + ff + p["b2"].astype(dt), k_cache, v_cache
+        return bias(x + ff, "b2"), k_cache, v_cache
 
     def _step_fn(self, tp_axis: str | None = None):
         """The ONE step body (embed -> scan over blocks -> final LN ->
@@ -185,10 +219,14 @@ class GptDecoder:
                 )
                 emb = jnp.where(in_range[..., None], emb, 0.0)
                 emb = lax.psum(emb, tp_axis)
-            posv = lax.dynamic_slice_in_dim(
-                params["pos_embedding"], pos, t, axis=0
-            )
-            x = (emb + posv).astype(cd)
+            if cfg.pos_style == "rope":
+                # Rotary positions enter inside each block's q/k.
+                x = emb.astype(cd)
+            else:
+                posv = lax.dynamic_slice_in_dim(
+                    params["pos_embedding"], pos, t, axis=0
+                )
+                x = (emb + posv).astype(cd)
 
             def body(carry, layer):
                 x = carry
@@ -199,17 +237,26 @@ class GptDecoder:
             x, (new_k, new_v) = lax.scan(
                 body, x, (params["stack"], cache["k"], cache["v"])
             )
-            x = _layer_norm(
-                x.astype(jnp.float32),
-                params["final_ln_scale"],
-                params["final_ln_bias"],
-                cfg.layer_norm_eps,
-            )
-            # Tied head, fp32. Under tp each shard produces its vocab
-            # slice [B, T, Vpad/tp]; the caller's out_specs concatenate
-            # the slices into the global logits (no in-body collective,
+            xf = x.astype(jnp.float32)
+            if cfg.norm_type == "rms":
+                x = _rms_norm(
+                    xf, params["final_ln_scale"], cfg.layer_norm_eps
+                )
+            else:
+                x = _layer_norm(
+                    xf,
+                    params["final_ln_scale"],
+                    params["final_ln_bias"],
+                    cfg.layer_norm_eps,
+                )
+            # Output head, fp32: tied to the embedding unless the
+            # checkpoint shipped a distinct lm_head (untied llama
+            # releases). Under tp each shard produces its vocab slice
+            # [B, T, Vpad/tp]; the caller's out_specs concatenate the
+            # slices into the global logits (no in-body collective,
             # and shard_map's replication checking stays on).
-            logits = x @ params["token_embedding"].T
+            head = params.get("lm_head", params["token_embedding"])
+            logits = x @ head.T
             new_cache = {"k": new_k, "v": new_v, "pos": pos + t}
             return logits, new_cache
 
@@ -326,6 +373,11 @@ class SpmdGptDecoder(GptDecoder):
                 f"heads={cfg.num_heads}, dim={cfg.dim}, "
                 f"ffn_dim={cfg.ffn_dim} must all divide by tp={tp}"
             )
+        if cfg.kv_heads % tp:
+            raise ValueError(
+                f"num_kv_heads={cfg.kv_heads} must divide by tp={tp} "
+                "(each shard needs whole kv head groups)"
+            )
         # Real vocab sizes (50257, 32000, ...) rarely divide by tp:
         # pad the sharded table instead of rejecting (padded rows are
         # zeros, masked out of lookups and sliced off the logits).
@@ -336,15 +388,18 @@ class SpmdGptDecoder(GptDecoder):
         from jax.sharding import PartitionSpec as P
 
         tp = self.tp_axis
-        return {
+        specs = {
             # Megatron vocab sharding: embedding rows over tp; the
             # tied head reuses the same shards.
             "token_embedding": P(tp, None),
-            "pos_embedding": P(),
             "final_ln_scale": P(),
-            "final_ln_bias": P(),
-            "stack": stack_specs(None, tp),
+            "stack": stack_specs(None, tp, cfg=self.cfg),
         }
+        if self.cfg.pos_style == "learned":
+            specs["pos_embedding"] = P()
+        if self.cfg.norm_type == "layer":
+            specs["final_ln_bias"] = P()
+        return specs
 
     def shard_params(self, params: dict) -> dict:
         """Place replicated-init params onto the mesh: column/row
@@ -353,6 +408,12 @@ class SpmdGptDecoder(GptDecoder):
         from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as P
 
+        if "lm_head" in params:
+            raise NotImplementedError(
+                "untied output heads are not supported under tensor "
+                "parallelism yet — the single-device GptDecoder serves "
+                "untied checkpoints"
+            )
         emb = params["token_embedding"]
         pad = self._vocab_padded - emb.shape[0]
         if pad:
@@ -413,7 +474,7 @@ class SpmdGptDecoder(GptDecoder):
 
         cfg = self.cfg
         dh = cfg.dim // cfg.num_heads
-        shape = (cfg.num_layers, batch, cfg.num_heads, cfg.max_len, dh)
+        shape = (cfg.num_layers, batch, cfg.kv_heads, cfg.max_len, dh)
         spec = self._cache_spec()
         # Allocate DIRECTLY sharded: materializing the full replicated
         # cache on device 0 first would transiently need tp x the

@@ -52,29 +52,83 @@ class TransformerConfig:
     # this makes the SPMD stack a trainable GPT — the same params the
     # KV-cache decoder (defer_tpu/models/gpt.py) serves.
     causal: bool = False
+    # -- llama-family knobs (defaults preserve the BERT/GPT behavior;
+    #    defer_tpu/models/llama.py sets the full combination) --------
+    # Grouped-query attention: K/V project to this many heads (each
+    # shared by num_heads/num_kv_heads query heads). None = MHA.
+    num_kv_heads: int | None = None
+    norm_type: str = "layer"  # "layer" | "rms" (scale-only, no mean)
+    ffn_style: str = "gelu"  # "gelu" | "swiglu" (gate*up, biasless F)
+    pos_style: str = "learned"  # "learned" table | "rope" (rotary q/k)
+    use_bias: bool = True  # llama: no projection biases at all
+    rope_theta: float = 10000.0
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    def __post_init__(self):
+        if self.num_heads % self.kv_heads:
+            raise ValueError(
+                f"num_kv_heads={self.kv_heads} must divide "
+                f"num_heads={self.num_heads}"
+            )
+        if self.ffn_style == "swiglu" and self.num_experts:
+            raise ValueError("swiglu MoE blocks are not supported")
+        # Fail at construction, not as a KeyError deep inside jit
+        # tracing (a typo'd knob would otherwise silently select the
+        # wrong architecture or crash on a missing param key).
+        for field, allowed in (
+            ("norm_style", ("post", "pre")),
+            ("norm_type", ("layer", "rms")),
+            ("ffn_style", ("gelu", "swiglu")),
+            ("pos_style", ("learned", "rope")),
+        ):
+            v = getattr(self, field)
+            if v not in allowed:
+                raise ValueError(
+                    f"{field}={v!r}: must be one of {allowed}"
+                )
 
 
 def init_stack(
     rng: jax.Array, cfg: TransformerConfig, dtype: Any = jnp.float32
 ) -> dict:
-    """Parameters for L stacked encoder blocks, leading axis = layer."""
+    """Parameters for L stacked encoder blocks, leading axis = layer.
+
+    The key set follows the config: GQA narrows wk/wv to the KV head
+    width, use_bias=False drops every b*, norm_type="rms" drops the
+    norm biases, and ffn_style="swiglu" adds the w3 up-projection."""
     L, D, F = cfg.num_layers, cfg.dim, cfg.ffn_dim
+    dkv = cfg.kv_heads * (D // cfg.num_heads)
     ks = jax.random.split(rng, 8)
     s = D**-0.5
     p = {
         "wq": jax.random.normal(ks[0], (L, D, D), dtype) * s,
-        "wk": jax.random.normal(ks[1], (L, D, D), dtype) * s,
-        "wv": jax.random.normal(ks[2], (L, D, D), dtype) * s,
-        "bq": jnp.zeros((L, D), dtype),
-        "bk": jnp.zeros((L, D), dtype),
-        "bv": jnp.zeros((L, D), dtype),
+        "wk": jax.random.normal(ks[1], (L, D, dkv), dtype) * s,
+        "wv": jax.random.normal(ks[2], (L, D, dkv), dtype) * s,
         "wo": jax.random.normal(ks[3], (L, D, D), dtype) * s,
-        "bo": jnp.zeros((L, D), dtype),
         "ln1_scale": jnp.ones((L, D), dtype),
-        "ln1_bias": jnp.zeros((L, D), dtype),
         "ln2_scale": jnp.ones((L, D), dtype),
-        "ln2_bias": jnp.zeros((L, D), dtype),
     }
+    if cfg.use_bias:
+        p.update(
+            {
+                "bq": jnp.zeros((L, D), dtype),
+                "bk": jnp.zeros((L, dkv), dtype),
+                "bv": jnp.zeros((L, dkv), dtype),
+                "bo": jnp.zeros((L, D), dtype),
+            }
+        )
+    if cfg.norm_type == "layer":
+        p.update(
+            {
+                "ln1_bias": jnp.zeros((L, D), dtype),
+                "ln2_bias": jnp.zeros((L, D), dtype),
+            }
+        )
+    if cfg.ffn_style == "swiglu":
+        p["w3"] = jax.random.normal(ks[7], (L, D, F), dtype) * s
     if cfg.num_experts:
         E = cfg.num_experts
         p.update(
@@ -91,12 +145,13 @@ def init_stack(
         p.update(
             {
                 "w1": jax.random.normal(ks[4], (L, D, F), dtype) * s,
-                "b1": jnp.zeros((L, F), dtype),
                 "w2": jax.random.normal(ks[5], (L, F, D), dtype)
                 * (F**-0.5),
-                "b2": jnp.zeros((L, D), dtype),
             }
         )
+        if cfg.use_bias:
+            p["b1"] = jnp.zeros((L, F), dtype)
+            p["b2"] = jnp.zeros((L, D), dtype)
     return p
 
 
@@ -106,25 +161,43 @@ def stack_specs(
     *,
     ep_axis: str | None = None,
     moe: bool = False,
+    cfg: TransformerConfig | None = None,
 ) -> dict:
     """PartitionSpecs matching init_stack: layer axis -> stage axis;
     q/k/v/ffn-in column-parallel, out/ffn-out row-parallel over tp; with
-    moe=True the expert axis of the FFN weights shards over ep_axis."""
+    moe=True the expert axis of the FFN weights shards over ep_axis.
+    Pass `cfg` to tailor the key set to a llama-style stack (dropped
+    biases, rms norms, swiglu w3 — all matching init_stack)."""
     st, tp, ep = stage_axis, tp_axis, ep_axis
+    use_bias = cfg.use_bias if cfg is not None else True
+    layer_norm = cfg.norm_type == "layer" if cfg is not None else True
+    swiglu = cfg.ffn_style == "swiglu" if cfg is not None else False
     p = {
         "wq": P(st, None, tp),
         "wk": P(st, None, tp),
         "wv": P(st, None, tp),
-        "bq": P(st, tp),
-        "bk": P(st, tp),
-        "bv": P(st, tp),
         "wo": P(st, tp, None),
-        "bo": P(st, None),
         "ln1_scale": P(st, None),
-        "ln1_bias": P(st, None),
         "ln2_scale": P(st, None),
-        "ln2_bias": P(st, None),
     }
+    if use_bias:
+        p.update(
+            {
+                "bq": P(st, tp),
+                "bk": P(st, tp),
+                "bv": P(st, tp),
+                "bo": P(st, None),
+            }
+        )
+    if layer_norm:
+        p.update(
+            {
+                "ln1_bias": P(st, None),
+                "ln2_bias": P(st, None),
+            }
+        )
+    if swiglu:
+        p["w3"] = P(st, None, tp)
     if moe:
         p.update(
             {
@@ -139,11 +212,12 @@ def stack_specs(
         p.update(
             {
                 "w1": P(st, None, tp),
-                "b1": P(st, tp),
                 "w2": P(st, tp, None),
-                "b2": P(st, None),
             }
         )
+        if use_bias:
+            p["b1"] = P(st, tp)
+            p["b2"] = P(st, None)
     return p
 
 
@@ -208,6 +282,67 @@ def _layer_norm(x, scale, bias, eps):
     )
 
 
+def _rms_norm(x, scale, eps):
+    """Scale-only RMS normalization (llama), fp32 statistics."""
+    xf = x.astype(jnp.float32)
+    out = xf * lax.rsqrt(jnp.mean(jnp.square(xf), -1, keepdims=True) + eps)
+    return (out * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def norm_apply(cfg: TransformerConfig, x, p: dict, which: str):
+    """The config's normalization ("ln1"/"ln2" param group)."""
+    if cfg.norm_type == "rms":
+        return _rms_norm(x, p[f"{which}_scale"], cfg.layer_norm_eps)
+    return _layer_norm(
+        x, p[f"{which}_scale"], p[f"{which}_bias"], cfg.layer_norm_eps
+    )
+
+
+def apply_rope(
+    x_flat: jax.Array,
+    head_dim: int,
+    positions: jax.Array,
+    theta: float,
+) -> jax.Array:
+    """Rotary position embedding on a flat (B, T, H*Dh) projection.
+
+    Rotation is per-head and head-independent, so reshaping to
+    (B, T, H, Dh) handles any head count — the SAME helper serves full
+    q, GQA-narrow k, and tensor-parallel local shards. Pairing is the
+    rotate-half convention (first half with second half), matching HF
+    transformers' llama so checkpoints transplant bit-compatibly.
+    `positions` are the ABSOLUTE sequence positions of the T tokens
+    (decode passes cache_pos + arange(T), sequence-parallel shards
+    pass their global offsets)."""
+    b, t, d = x_flat.shape
+    x = x_flat.reshape(b, t, d // head_dim, head_dim)
+    half = head_dim // 2
+    freqs = theta ** (
+        -jnp.arange(0, half, dtype=jnp.float32) * 2.0 / head_dim
+    )
+    ang = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # (T, half)
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(
+        jnp.float32
+    )
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    ).astype(x_flat.dtype)
+    return out.reshape(b, t, d)
+
+
+def repeat_kv(x_flat: jax.Array, head_dim: int, groups: int) -> jax.Array:
+    """Expand a flat (B, T, H_kv*Dh) K/V projection to (B, T, H*Dh) by
+    repeating each KV head for its query-head group (GQA)."""
+    if groups == 1:
+        return x_flat
+    b, t, d = x_flat.shape
+    x = x_flat.reshape(b, t, d // head_dim, head_dim)
+    x = jnp.repeat(x, groups, axis=2)
+    return x.reshape(b, t, d * groups)
+
+
 def block_apply(
     p: dict,
     x: jax.Array,
@@ -233,16 +368,29 @@ def block_apply(
     dt = x.dtype
     tp_size = 1 if tp_axis is None else lax.axis_size(tp_axis)
     local_heads = cfg.num_heads // tp_size
+    dh = cfg.dim // cfg.num_heads
+    groups = cfg.num_heads // cfg.kv_heads
     pre = cfg.norm_style == "pre"
 
-    a_in = (
-        _layer_norm(x, p["ln1_scale"], p["ln1_bias"], cfg.layer_norm_eps)
-        if pre
-        else x
-    )
-    q = a_in @ p["wq"].astype(dt) + p["bq"].astype(dt)
-    k = a_in @ p["wk"].astype(dt) + p["bk"].astype(dt)
-    v = a_in @ p["wv"].astype(dt) + p["bv"].astype(dt)
+    def bias(h, name):
+        return h + p[name].astype(dt) if name in p else h
+
+    a_in = norm_apply(cfg, x, p, "ln1") if pre else x
+    q = bias(a_in @ p["wq"].astype(dt), "bq")
+    k = bias(a_in @ p["wk"].astype(dt), "bk")
+    v = bias(a_in @ p["wv"].astype(dt), "bv")
+    if cfg.pos_style == "rope":
+        s_local = q.shape[1]
+        offset = (
+            0 if sp_axis is None else lax.axis_index(sp_axis) * s_local
+        )
+        positions = offset + jnp.arange(s_local)
+        q = apply_rope(q, dh, positions, cfg.rope_theta)
+        k = apply_rope(k, dh, positions, cfg.rope_theta)
+    # GQA: expand KV head groups AFTER rope so each query head in a
+    # group attends its shared (rotated) KV head.
+    k = repeat_kv(k, dh, groups)
+    v = repeat_kv(v, dh, groups)
     attn = multi_head_attention(
         q,
         k,
@@ -256,30 +404,32 @@ def block_apply(
     attn = attn @ p["wo"].astype(dt)
     if tp_axis is not None:
         attn = lax.psum(attn, tp_axis)
-    attn = attn + p["bo"].astype(dt)
+    attn = bias(attn, "bo")
     if pre:
         x = x + attn
-        f_in = _layer_norm(
-            x, p["ln2_scale"], p["ln2_bias"], cfg.layer_norm_eps
-        )
+        f_in = norm_apply(cfg, x, p, "ln2")
     else:
-        x = _layer_norm(
-            x + attn, p["ln1_scale"], p["ln1_bias"], cfg.layer_norm_eps
-        )
+        x = norm_apply(cfg, x + attn, p, "ln1")
         f_in = x
 
     if "router" in p:
         h = moe_ffn(p, f_in, tp_axis=tp_axis, ep_axis=ep_axis)
+    elif cfg.ffn_style == "swiglu":
+        # llama FFN: silu(gate) * up -> down (w1=gate, w3=up, w2=down).
+        gate = jax.nn.silu(f_in @ p["w1"].astype(dt))
+        h = (gate * (f_in @ p["w3"].astype(dt))) @ p["w2"].astype(dt)
+        if tp_axis is not None:
+            h = lax.psum(h, tp_axis)
     else:
-        h = f_in @ p["w1"].astype(dt) + p["b1"].astype(dt)
+        h = bias(f_in @ p["w1"].astype(dt), "b1")
         h = jax.nn.gelu(h)
         h = h @ p["w2"].astype(dt)
         if tp_axis is not None:
             h = lax.psum(h, tp_axis)
-        h = h + p["b2"].astype(dt)
+        h = bias(h, "b2")
     if pre:
         return x + h
-    return _layer_norm(x + h, p["ln2_scale"], p["ln2_bias"], cfg.layer_norm_eps)
+    return norm_apply(cfg, x + h, p, "ln2")
 
 
 def layers_apply(
