@@ -249,22 +249,49 @@ def bench_vit(devices) -> dict:
 def bench_gpt_decode(devices) -> dict:
     """KV-cache decode: steady-state ms/token and tokens/sec for a
     GPT-2-small-shaped decoder (batch 8)."""
+    from defer_tpu.parallel.transformer_stack import TransformerConfig
+
+    return _bench_decode(
+        devices,
+        TransformerConfig(
+            num_layers=12,
+            dim=768,
+            num_heads=12,
+            ffn_dim=3072,
+            vocab_size=32000,
+            max_len=512,
+            norm_style="pre",
+        ),
+        "gpt-small",
+    )
+
+
+def bench_llama_decode(devices) -> dict:
+    """Llama-architecture decode (RMSNorm + rotary + GQA + SwiGLU) at
+    ~1B scale: the modern serving shape, with the KV cache narrowed to
+    the GQA head count."""
+    from defer_tpu.models.llama import llama_config
+
+    return _bench_decode(
+        devices,
+        llama_config(
+            num_layers=16,
+            dim=2048,
+            num_heads=16,
+            num_kv_heads=4,
+            ffn_dim=5632,
+            vocab_size=32000,
+            max_len=512,
+        ),
+        "llama-1b-gqa",
+    )
+
+
+def _bench_decode(devices, cfg, label: str) -> dict:
     import jax
     import jax.numpy as jnp
 
-    from defer_tpu.models.gpt import GptDecoder
-    from defer_tpu.parallel.transformer_stack import TransformerConfig
-
-    cfg = TransformerConfig(
-        num_layers=12,
-        dim=768,
-        num_heads=12,
-        ffn_dim=3072,
-        vocab_size=32000,
-        max_len=512,
-        norm_style="pre",
-    )
-    from defer_tpu.models.gpt import sample_token
+    from defer_tpu.models.gpt import GptDecoder, sample_token
 
     dec = GptDecoder(cfg, compute_dtype=jnp.bfloat16)
     params = jax.device_put(dec.init(jax.random.key(0)), devices[0])
@@ -302,7 +329,7 @@ def bench_gpt_decode(devices) -> dict:
         "batch": batch,
         "prefill_s": round(prefill_s, 3),
     }
-    log(f"gpt-small decode single-chip: {rec}")
+    log(f"{label} decode single-chip: {rec}")
     return rec
 
 
@@ -531,6 +558,7 @@ def run_bench() -> dict:
         "bert_base": None,
         "vit_s16": None,
         "gpt_decode": None,
+        "llama_decode": None,
         "pallas_attention": None,
     }
     snapshot(result)
@@ -672,6 +700,7 @@ def run_bench() -> dict:
         sections = [
             ("vit_s16", bench_vit),
             ("gpt_decode", bench_gpt_decode),
+            ("llama_decode", bench_llama_decode),
             ("bert_base", bench_bert),
         ]
         # Mosaic-kernel section last. It runs wherever the pallas gate

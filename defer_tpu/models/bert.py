@@ -164,6 +164,11 @@ class SpmdBert:
                 f"axis size {tp} — otherwise attention silently computes "
                 "with the wrong head grouping"
             )
+        if self.cfg.kv_heads % tp:
+            raise ValueError(
+                f"num_kv_heads={self.cfg.kv_heads} must divide by the "
+                f"model axis size {tp} (whole kv head groups per shard)"
+            )
 
     def _stack_param_specs(self):
         return staged_specs(
@@ -172,6 +177,7 @@ class SpmdBert:
                 self.tp_axis,
                 ep_axis=self.ep_axis,
                 moe=bool(self.cfg.num_experts),
+                cfg=self.cfg,
             ),
             "stage",
         )
@@ -195,16 +201,9 @@ class SpmdBert:
         from jax.sharding import NamedSharding
 
         rep = NamedSharding(self.mesh, P())
-        return {
+        params = {
             "token_embedding": jax.device_put(
                 jax.random.normal(k_embed, (cfg.vocab_size, cfg.dim)) * 0.02,
-                rep,
-            ),
-            "pos_embedding": jax.device_put(
-                jax.random.normal(
-                    jax.random.fold_in(k_embed, 1), (cfg.max_len, cfg.dim)
-                )
-                * 0.02,
                 rep,
             ),
             "pooler_w": jax.device_put(
@@ -214,6 +213,15 @@ class SpmdBert:
             "pooler_b": jax.device_put(jnp.zeros((cfg.dim,)), rep),
             "stack": stacked,
         }
+        if cfg.pos_style == "learned":
+            params["pos_embedding"] = jax.device_put(
+                jax.random.normal(
+                    jax.random.fold_in(k_embed, 1), (cfg.max_len, cfg.dim)
+                )
+                * 0.02,
+                rep,
+            )
+        return params
 
     def make_step(self):
         """Jitted (params, ids [M, B, S]) -> pooled [M, B, D].
@@ -249,7 +257,8 @@ class SpmdBert:
         def step(params, ids):
             seq = ids.shape[-1]
             emb = jnp.take(params["token_embedding"], ids, axis=0)
-            emb = emb + params["pos_embedding"][:seq]
+            if cfg.pos_style == "learned":
+                emb = emb + params["pos_embedding"][:seq]
             xs = emb.astype(cd)  # [M, B, S, D]
             ys = pipe(params["stack"], xs)  # [M, B, S, D]
             cls = ys[:, :, 0, :]
@@ -266,7 +275,9 @@ class SpmdBert:
         cd = self.compute_dtype
         seq = ids.shape[-1]
         emb = jnp.take(params["token_embedding"], ids, axis=0)
-        emb = (emb + params["pos_embedding"][:seq]).astype(cd)
+        if cfg.pos_style == "learned":
+            emb = emb + params["pos_embedding"][:seq]
+        emb = emb.astype(cd)
         # Undo the stage stacking: [S, L/S, ...] -> [L, ...]
         flat = jax.tree_util.tree_map(
             lambda a: jnp.asarray(a).reshape(-1, *a.shape[2:]),

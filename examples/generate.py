@@ -47,6 +47,20 @@ def main() -> None:
     ap.add_argument("--steps", type=int, default=64)
     ap.add_argument("--tp", type=int, default=1)
     ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument(
+        "--family",
+        choices=("gpt", "llama"),
+        default="gpt",
+        help="llama = RMSNorm + rotary + grouped-query attention + "
+        "SwiGLU (biasless), with the KV cache sized by --kv-heads",
+    )
+    ap.add_argument(
+        "--kv-heads",
+        type=int,
+        default=None,
+        help="GQA kv head count, any family (llama default: heads/4; "
+        "gpt default: MHA)",
+    )
     args = ap.parse_args()
 
     if args.prompt_len + args.steps + 1 > args.max_len:
@@ -56,15 +70,31 @@ def main() -> None:
             "benchmark degenerate work"
         )
 
-    cfg = TransformerConfig(
-        num_layers=args.layers,
-        dim=args.dim,
-        num_heads=args.heads,
-        ffn_dim=args.ffn,
-        vocab_size=args.vocab,
-        max_len=args.max_len,
-        norm_style="pre",
-    )
+    if args.family == "llama":
+        from defer_tpu.models.llama import llama_config
+
+        cfg = llama_config(
+            num_layers=args.layers,
+            dim=args.dim,
+            num_heads=args.heads,
+            num_kv_heads=args.kv_heads or max(1, args.heads // 4),
+            ffn_dim=args.ffn,
+            vocab_size=args.vocab,
+            max_len=args.max_len,
+        )
+    else:
+        # GQA is a shared-stack knob, not llama-exclusive: honor
+        # --kv-heads here too instead of silently ignoring it.
+        cfg = TransformerConfig(
+            num_layers=args.layers,
+            dim=args.dim,
+            num_heads=args.heads,
+            num_kv_heads=args.kv_heads,
+            ffn_dim=args.ffn,
+            vocab_size=args.vocab,
+            max_len=args.max_len,
+            norm_style="pre",
+        )
     if args.tp > 1:
         mesh = make_mesh({"model": args.tp}, jax.devices()[: args.tp])
         dec = SpmdGptDecoder(cfg, mesh=mesh)
