@@ -284,52 +284,88 @@ def bench_llama_decode(devices) -> dict:
             max_len=512,
         ),
         "llama-1b-gqa",
+        with_int8=True,
     )
 
 
-def _bench_decode(devices, cfg, label: str) -> dict:
+def _bench_decode(devices, cfg, label: str, with_int8: bool = False) -> dict:
     import jax
     import jax.numpy as jnp
 
     from defer_tpu.models.gpt import GptDecoder, sample_token
+    from defer_tpu.utils.roofline import peak_bandwidth
 
     dec = GptDecoder(cfg, compute_dtype=jnp.bfloat16)
-    params = jax.device_put(dec.init(jax.random.key(0)), devices[0])
+    init = dec.init(jax.random.key(0))
     batch, prompt_len, steps = 8, 128, 64
     step = dec.make_step()
     ids = jax.random.randint(
         jax.random.key(1), (batch, prompt_len), 0, cfg.vocab_size
     )
-    # Warm both compiled shapes on a throwaway cache so the timings
-    # below measure compute, not XLA compilation.
-    warm_cache = dec.init_cache(batch)
-    _, warm_cache = step(params, warm_cache, ids)
-    _, warm_cache = step(
-        params, warm_cache, jnp.zeros((batch, 1), ids.dtype)
+    dh = cfg.dim // cfg.num_heads
+    # The decode step contracts over the FULL static [.., max_len, ..]
+    # cache buffer every token (masking happens after the read), so
+    # that is the KV traffic — not just the live prefix.
+    kv_bytes = (
+        2 * cfg.num_layers * batch * cfg.kv_heads * cfg.max_len * dh * 2
     )
-    # Block on the SECOND step's cache so no warm-up work is still
-    # queued when the prefill timer starts.
-    jax.block_until_ready(warm_cache)
+    bw = peak_bandwidth(devices[0].device_kind)
 
-    rng = jax.random.key(2)
-    cache = dec.init_cache(batch)
-    t0 = time.perf_counter()
-    logits, cache = step(params, cache, ids)
-    logits.block_until_ready()
-    prefill_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        nxt, rng = sample_token(logits[:, -1:], rng, 0.0)
-        logits, cache = step(params, cache, nxt.astype(ids.dtype))
-    logits.block_until_ready()
-    per_tok = (time.perf_counter() - t0) / steps
-    rec = {
-        "ms_per_token": round(per_tok * 1e3, 3),
-        "tokens_per_sec": round(batch / per_tok, 1),
-        "batch": batch,
-        "prefill_s": round(prefill_s, 3),
-    }
+    def measure(params) -> dict:
+        # Warm both compiled shapes on a throwaway cache so the
+        # timings measure compute, not XLA compilation.
+        warm_cache = dec.init_cache(batch)
+        _, warm_cache = step(params, warm_cache, ids)
+        _, warm_cache = step(
+            params, warm_cache, jnp.zeros((batch, 1), ids.dtype)
+        )
+        # Block on the SECOND step's cache so no warm-up work is
+        # still queued when the prefill timer starts.
+        jax.block_until_ready(warm_cache)
+        rng = jax.random.key(2)
+        cache = dec.init_cache(batch)
+        t0 = time.perf_counter()
+        logits, cache = step(params, cache, ids)
+        logits.block_until_ready()
+        prefill_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            nxt, rng = sample_token(logits[:, -1:], rng, 0.0)
+            logits, cache = step(params, cache, nxt.astype(ids.dtype))
+        logits.block_until_ready()
+        per_tok = (time.perf_counter() - t0) / steps
+        # Decode is HBM-read bound: per step the chip reads every
+        # weight once (shared by the batch) plus the live KV prefix.
+        # Achieved GB/s against HBM peak is decode's MFU analogue.
+        param_bytes = sum(
+            a.size * a.dtype.itemsize
+            for a in jax.tree_util.tree_leaves(params)
+        )
+        achieved = (param_bytes + kv_bytes) / per_tok
+        return {
+            "ms_per_token": round(per_tok * 1e3, 3),
+            "tokens_per_sec": round(batch / per_tok, 1),
+            "batch": batch,
+            "prefill_s": round(prefill_s, 3),
+            "achieved_gbps": round(achieved / 1e9, 1),
+            "hbm_frac": round(achieved / bw, 3) if bw else None,
+        }
+
+    # Serving storage: bf16 params (decode reads every weight per
+    # token; fp32 storage would double the HBM traffic that bounds it).
+    rec = measure(jax.device_put(dec.cast_params(init), devices[0]))
     log(f"{label} decode single-chip: {rec}")
+    if with_int8:
+        # Weight-only int8 (models/quant.py): half the weight bytes
+        # again; quantize from the fp32 init for faithful scales.
+        from defer_tpu.models.quant import quantize_decoder_params
+
+        qrec = measure(
+            jax.device_put(quantize_decoder_params(init), devices[0])
+        )
+        qrec.pop("batch", None)
+        rec["int8"] = qrec
+        log(f"{label} int8 decode single-chip: {qrec}")
     return rec
 
 

@@ -98,6 +98,19 @@ class GptDecoder:
             p["final_ln_bias"] = jnp.zeros((cfg.dim,))
         return p
 
+    def cast_params(self, params: dict) -> dict:
+        """Float params re-stored in compute_dtype — the serving
+        configuration. Decode is weight-HBM-read bound, so fp32-stored
+        params (init's default, kept for test precision) cost 2x the
+        bandwidth of bf16 storage; the step's per-use astype then
+        becomes a no-op."""
+        return jax.tree_util.tree_map(
+            lambda a: a.astype(self.compute_dtype)
+            if jnp.issubdtype(a.dtype, jnp.floating)
+            else a,
+            params,
+        )
+
     def init_cache(self, batch: int) -> dict:
         cfg = self.cfg
         dh = cfg.dim // cfg.num_heads
@@ -133,14 +146,21 @@ class GptDecoder:
         cfg = self.cfg
         dt = x.dtype
         dh = cfg.dim // cfg.num_heads
+        from defer_tpu.models.quant import dequantize_leaf
+
+        def W(name):
+            # Plain bf16/fp32 matrices pass through; int8-quantized
+            # leaves ({"q","s"}, models/quant.py) widen here and XLA
+            # fuses the dequant into the matmul (HBM reads stay int8).
+            return dequantize_leaf(p[name], dt)
 
         def bias(h, name):
             return h + p[name].astype(dt) if name in p else h
 
         h = norm_apply(cfg, x, p, "ln1")
-        qf = bias(h @ p["wq"].astype(dt), "bq")
-        kf = bias(h @ p["wk"].astype(dt), "bk")
-        vf = bias(h @ p["wv"].astype(dt), "bv")
+        qf = bias(h @ W("wq"), "bq")
+        kf = bias(h @ W("wk"), "bk")
+        vf = bias(h @ W("wv"), "bv")
         if cfg.pos_style == "rope":
             positions = pos + jnp.arange(qf.shape[1])
             qf = apply_rope(qf, dh, positions, cfg.rope_theta)
@@ -172,21 +192,21 @@ class GptDecoder:
         attn = jnp.einsum("bkgts,bksd->bkgtd", weights, v_cache)
         attn = attn.reshape(b, h_q, t, dh)
         attn = attn.transpose(0, 2, 1, 3).reshape(b, t, h_q * dh)
-        attn = attn @ p["wo"].astype(dt)
+        attn = attn @ W("wo")
         if tp_axis is not None:
             attn = lax.psum(attn, tp_axis)
         attn = bias(attn, "bo")
         x = x + attn
         h2 = norm_apply(cfg, x, p, "ln2")
         if cfg.ffn_style == "swiglu":
-            gate = jax.nn.silu(h2 @ p["w1"].astype(dt))
-            ff = (gate * (h2 @ p["w3"].astype(dt))) @ p["w2"].astype(dt)
+            gate = jax.nn.silu(h2 @ W("w1"))
+            ff = (gate * (h2 @ W("w3"))) @ W("w2")
             if tp_axis is not None:
                 ff = lax.psum(ff, tp_axis)
             return x + ff, k_cache, v_cache
-        ff = bias(h2 @ p["w1"].astype(dt), "b1")
+        ff = bias(h2 @ W("w1"), "b1")
         ff = jax.nn.gelu(ff)
-        ff = ff @ p["w2"].astype(dt)
+        ff = ff @ W("w2")
         if tp_axis is not None:
             ff = lax.psum(ff, tp_axis)
         return bias(x + ff, "b2"), k_cache, v_cache
@@ -201,11 +221,23 @@ class GptDecoder:
         cd = self.compute_dtype
 
         def step(params, cache, ids):
+            from defer_tpu.models.quant import dequantize_leaf
+
             b, t = ids.shape
             pos = cache["pos"]
             table = params["token_embedding"]
             if tp_axis is None:
-                emb = jnp.take(table, ids, axis=0)
+                if isinstance(table, dict) and "q" in table:
+                    # int8 table: gather the int8 rows, widen just the
+                    # gathered [B, T, D] slice.
+                    emb = (
+                        jnp.take(table["q"], ids, axis=0).astype(
+                            jnp.float32
+                        )
+                        * table["s"]
+                    )
+                else:
+                    emb = jnp.take(table, ids, axis=0)
             else:
                 # Vocab-row sharding: this shard owns rows
                 # [v0, v0 + V_local); out-of-range ids contribute
@@ -256,6 +288,7 @@ class GptDecoder:
             # slices into the global logits (no in-body collective,
             # and shard_map's replication checking stays on).
             head = params.get("lm_head", params["token_embedding"])
+            head = dequantize_leaf(head, jnp.float32)
             logits = x @ head.T
             new_cache = {"k": new_k, "v": new_v, "pos": pos + t}
             return logits, new_cache
@@ -413,6 +446,15 @@ class SpmdGptDecoder(GptDecoder):
                 "untied output heads are not supported under tensor "
                 "parallelism yet — the single-device GptDecoder serves "
                 "untied checkpoints"
+            )
+        if any(
+            isinstance(v, dict) and "q" in v
+            for v in [params["token_embedding"], *params["stack"].values()]
+        ):
+            raise NotImplementedError(
+                "int8-quantized params are not supported under tensor "
+                "parallelism yet — the single-device GptDecoder serves "
+                "quantized checkpoints"
             )
         emb = params["token_embedding"]
         pad = self._vocab_padded - emb.shape[0]

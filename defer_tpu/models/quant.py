@@ -1,0 +1,84 @@
+"""Weight-only int8 quantization for decode serving (w8a16).
+
+Decode latency is weight-HBM-read bound (models/gpt.py): every matrix
+is read once per token. bf16 storage halves fp32 traffic; symmetric
+per-output-channel int8 halves it again, with activations (and the
+matmul accumulation) staying bf16/fp32 — the standard TPU serving
+recipe. XLA fuses the dequant (convert + scale) into the consuming
+matmul, so HBM sees 1 byte/weight and VMEM does the widening.
+
+The reference's analogous seam is its lossy wire codec (ZFP fixed
+precision, reference src/dispatcher.py:89-92) — compression where the
+bytes hurt; here the bytes that hurt are HBM reads, not sockets.
+
+Representation: a quantized leaf is `{"q": int8[..., out], "s":
+f32 broadcastable-to-q}` — per-output-channel scales, kept per layer
+(L leading on both) for stacked matrices — a plain pytree so
+`lax.scan` over stacked layers, jit donation and tree_map all keep
+working untouched.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+#: stack matrices worth quantizing (biases/norm scales are tiny).
+DEFAULT_KEYS = ("wq", "wk", "wv", "wo", "w1", "w2", "w3")
+
+
+def quantize_leaf(w: jax.Array) -> dict[str, jax.Array]:
+    """Symmetric per-output-channel int8: q = round(w / s) with
+    s = max|w| / 127 over the contraction axes. The scale keeps
+    broadcastable (keepdims) shape, and layer-stacked [L, in, out]
+    matrices get PER-LAYER channel scales with the L axis leading —
+    so `lax.scan` over stacked params slices q and s together."""
+    wf = jnp.asarray(w, jnp.float32)
+    red = (
+        tuple(range(1, wf.ndim - 1))
+        if wf.ndim >= 3
+        else tuple(range(wf.ndim - 1))
+    )
+    s = jnp.max(jnp.abs(wf), axis=red, keepdims=True) / 127.0
+    s = jnp.maximum(s, 1e-12)
+    q = jnp.clip(jnp.round(wf / s), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": s.astype(jnp.float32)}
+
+
+def dequantize_leaf(leaf: Any, dtype: Any) -> jax.Array:
+    """Widen {"q","s"} back to `dtype`; pass plain arrays through
+    (cast), so call sites handle mixed quantized/plain trees with one
+    helper. Inside jit the convert+scale fuses into the consumer."""
+    if isinstance(leaf, dict) and "q" in leaf:
+        return leaf["q"].astype(dtype) * leaf["s"].astype(dtype)
+    return leaf.astype(dtype)
+
+
+def quantize_decoder_params(
+    params: dict, *, keys: tuple[str, ...] = DEFAULT_KEYS
+) -> dict:
+    """Quantize a GptDecoder/llama param tree for serving: the stack's
+    matmul weights plus the embedding / untied head. Norm scales,
+    biases and positions stay in their float dtype (tiny, and norm
+    precision matters)."""
+    out = dict(params)
+    out["stack"] = {
+        k: quantize_leaf(v) if k in keys else v
+        for k, v in params["stack"].items()
+    }
+    out["token_embedding"] = quantize_leaf(params["token_embedding"])
+    if "lm_head" in params:
+        out["lm_head"] = quantize_leaf(params["lm_head"])
+    return out
+
+
+def quantization_error(w: jax.Array) -> float:
+    """Max relative reconstruction error of quantize_leaf on `w` —
+    diagnostics for tests and calibration sanity checks."""
+    leaf = quantize_leaf(w)
+    back = dequantize_leaf(leaf, jnp.float32)
+    denom = jnp.maximum(jnp.max(jnp.abs(w)), 1e-12)
+    return float(jnp.max(jnp.abs(back - jnp.asarray(w, jnp.float32))) / denom)

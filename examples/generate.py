@@ -95,15 +95,17 @@ def main() -> None:
             max_len=args.max_len,
             norm_style="pre",
         )
+    # Serving storage: params in the compute dtype (decode reads every
+    # weight per token — fp32 storage would double the HBM traffic).
     if args.tp > 1:
         mesh = make_mesh({"model": args.tp}, jax.devices()[: args.tp])
         dec = SpmdGptDecoder(cfg, mesh=mesh)
-        params = dec.shard_params(dec.init(jax.random.key(0)))
+        params = dec.shard_params(dec.cast_params(dec.init(jax.random.key(0))))
         print(f"tensor-parallel decode over {args.tp} devices "
               f"({jax.devices()[0].device_kind})")
     else:
         dec = GptDecoder(cfg)
-        params = dec.init(jax.random.key(0))
+        params = dec.cast_params(dec.init(jax.random.key(0)))
         print(f"single-device decode ({jax.devices()[0].device_kind})")
 
     prompt = jax.random.randint(

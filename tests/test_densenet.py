@@ -16,6 +16,8 @@ from defer_tpu.graph.partition import (
 from defer_tpu.models import get_model
 from defer_tpu.parallel.pipeline import Pipeline
 
+pytestmark = pytest.mark.slow
+
 F32 = DeferConfig(compute_dtype=jnp.float32)
 
 

@@ -7,6 +7,9 @@ past the driver's budget (VERDICT r03: rc=124 three rounds running).
 import os
 import subprocess
 import sys
+import pytest
+
+pytestmark = pytest.mark.slow
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

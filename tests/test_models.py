@@ -6,6 +6,8 @@ import pytest
 from defer_tpu.graph.partition import validate_cut_points
 from defer_tpu.models import get_model, model_names
 
+pytestmark = pytest.mark.slow
+
 
 def test_model_registry_lists_models():
     names = model_names()
