@@ -52,6 +52,13 @@ class TransformerConfig:
     # this makes the SPMD stack a trainable GPT — the same params the
     # KV-cache decoder (defer_tpu/models/gpt.py) serves.
     causal: bool = False
+    # MoE dispatch: "dense" computes every local expert for every
+    # token and masks (exact, no drops, E_local x the FLOPs); "a2a"
+    # routes tokens to their expert's device with lax.all_to_all under
+    # a static per-expert capacity (the scaling path for large expert
+    # counts — tokens over capacity are dropped, Switch-style).
+    moe_dispatch: str = "dense"
+    capacity_factor: float = 1.25
     # -- llama-family knobs (defaults preserve the BERT/GPT behavior;
     #    defer_tpu/models/llama.py sets the full combination) --------
     # Grouped-query attention: K/V project to this many heads (each
@@ -75,6 +82,12 @@ class TransformerConfig:
             )
         if self.ffn_style == "swiglu" and self.num_experts:
             raise ValueError("swiglu MoE blocks are not supported")
+        if self.capacity_factor <= 0:
+            raise ValueError(
+                f"capacity_factor={self.capacity_factor} must be > 0 "
+                "(non-positive values would silently drop almost every "
+                "token to the residual path)"
+            )
         # Fail at construction, not as a KeyError deep inside jit
         # tracing (a typo'd knob would otherwise silently select the
         # wrong architecture or crash on a missing param key).
@@ -83,6 +96,7 @@ class TransformerConfig:
             ("norm_type", ("layer", "rms")),
             ("ffn_style", ("gelu", "swiglu")),
             ("pos_style", ("learned", "rope")),
+            ("moe_dispatch", ("dense", "a2a")),
         ):
             v = getattr(self, field)
             if v not in allowed:
@@ -244,10 +258,7 @@ def moe_ffn(
     e_local = p["w1"].shape[0]
     ep_idx = 0 if ep_axis is None else lax.axis_index(ep_axis)
 
-    logits = (x.astype(jnp.float32)) @ p["router"].astype(jnp.float32)
-    probs = jax.nn.softmax(logits, axis=-1)  # (B, S, E_global)
-    gate = probs.max(axis=-1)  # (B, S)
-    top = probs.argmax(axis=-1)  # (B, S)
+    top, gate = _route_top1(p["router"], x)  # (B, S) each
     global_ids = ep_idx * e_local + jnp.arange(e_local)
     dispatch = (
         (top[..., None] == global_ids) * gate[..., None]
@@ -270,6 +281,102 @@ def moe_ffn(
     if ep_axis is not None:
         out = lax.psum(out, ep_axis)
     return out.astype(dt)
+
+
+def _route_top1(router: jax.Array, x: jax.Array):
+    """Shared top-1 routing (fp32 softmax over the GLOBAL expert
+    count): returns (expert_index, gate) over x's leading axes. ONE
+    definition for both dispatches — dense/a2a equivalence depends on
+    the routing staying identical."""
+    logits = x.astype(jnp.float32) @ router.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return probs.argmax(axis=-1), probs.max(axis=-1)
+
+
+def moe_ffn_a2a(
+    p: dict,
+    x: jax.Array,
+    *,
+    capacity_factor: float = 1.25,
+    tp_axis: str | None = None,
+    ep_axis: str | None = None,
+) -> jax.Array:
+    """Top-1 MoE FFN with all-to-all expert dispatch on (B, S, D).
+
+    The scaling path dense dispatch can't reach: instead of every
+    device computing all its experts for all tokens, each token is
+    ROUTED — packed into a static (E, C, D) dispatch buffer (C =
+    capacity_factor x tokens/E, Switch-style; over-capacity tokens
+    fall through on the residual path with zero expert output), moved
+    to its expert's device by one `lax.all_to_all` over ep (ICI), run
+    through that device's experts only, and moved back by the inverse
+    all_to_all. Compute per device is E_local x (ep x C) tokens
+    regardless of E_global, and every shape is static.
+
+    Routing matches moe_ffn exactly (same replicated router, global
+    softmax, top-1 + gate), so with C large enough to drop nothing the
+    two dispatches are numerically equivalent — that equivalence is
+    the correctness test.
+    """
+    import math
+
+    dt = x.dtype
+    b, s, d = x.shape
+    n = b * s
+    e_local = p["w1"].shape[0]
+    ep = 1 if ep_axis is None else lax.axis_size(ep_axis)
+    e_global = ep * e_local
+    cap = max(1, math.ceil(capacity_factor * n / e_global))
+
+    xf = x.reshape(n, d)
+    top, gate = _route_top1(p["router"], xf)  # (N,) each
+
+    onehot = jax.nn.one_hot(top, e_global, dtype=jnp.int32)  # (N, E)
+    # Arrival-order position of each token within its expert's queue;
+    # tokens at position >= cap are dropped (Switch-style).
+    pos_in_e = jnp.cumsum(onehot, axis=0) - 1  # (N, E)
+    keep = (pos_in_e < cap) & (onehot > 0)
+    dispatch = (
+        jax.nn.one_hot(pos_in_e, cap, dtype=jnp.float32)
+        * keep[..., None]
+    )  # (N, E, C)
+    combine = dispatch * gate[:, None, None].astype(jnp.float32)
+
+    xin = jnp.einsum("nd,nec->ecd", xf.astype(jnp.float32), dispatch)
+    if ep_axis is not None:
+        # (E, C, D) -> (E_local, ep*C, D): expert-group rows k go to
+        # device k (split over the expert axis); the received sender
+        # chunks concatenate on the slot axis in sender order, so
+        # slot block j belongs to device j for the inverse route.
+        xin = lax.all_to_all(
+            xin, ep_axis, split_axis=0, concat_axis=1, tiled=True
+        )
+
+    h = jnp.einsum("ecd,edf->ecf", xin.astype(dt), p["w1"].astype(dt))
+    h = h + p["b1"].astype(dt)[:, None, :]
+    h = jax.nn.gelu(h)
+    y = jnp.einsum("ecf,efd->ecd", h, p["w2"].astype(dt))
+    if tp_axis is not None:
+        y = lax.psum(y, tp_axis)
+    y = y + p["b2"].astype(dt)[:, None, :]
+
+    if ep_axis is not None:
+        # Inverse route: slot chunks return to their sender, expert
+        # chunks stack back into global expert order.
+        y = lax.all_to_all(
+            y, ep_axis, split_axis=1, concat_axis=0, tiled=True
+        )
+
+    out = jnp.einsum("ecd,nec->nd", y.astype(jnp.float32), combine)
+    out = out.astype(dt).reshape(b, s, d)
+    if ep_axis is not None:
+        # Every device holds identical values here (tokens are
+        # replicated over ep, so each dispatched the same batch and
+        # received the same expert outputs back) — pmean closes the
+        # shard_map varying type to replicated, with the same
+        # collective profile as the dense dispatch's psum.
+        out = lax.pmean(out, ep_axis)
+    return out
 
 
 def _layer_norm(x, scale, bias, eps):
@@ -413,7 +520,16 @@ def block_apply(
         f_in = x
 
     if "router" in p:
-        h = moe_ffn(p, f_in, tp_axis=tp_axis, ep_axis=ep_axis)
+        if cfg.moe_dispatch == "a2a":
+            h = moe_ffn_a2a(
+                p,
+                f_in,
+                capacity_factor=cfg.capacity_factor,
+                tp_axis=tp_axis,
+                ep_axis=ep_axis,
+            )
+        else:
+            h = moe_ffn(p, f_in, tp_axis=tp_axis, ep_axis=ep_axis)
     elif cfg.ffn_style == "swiglu":
         # llama FFN: silu(gate) * up -> down (w1=gate, w3=up, w2=down).
         gate = jax.nn.silu(f_in @ p["w1"].astype(dt))
