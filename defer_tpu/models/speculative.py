@@ -1,0 +1,176 @@
+"""Speculative decoding: draft-model proposal + target verification.
+
+Decode is HBM-bound (one full weight read per token, models/gpt.py);
+speculative decoding amortizes that read: a small DRAFT model proposes
+k tokens autoregressively, then the TARGET verifies all k in ONE
+forward (k positions through one weight read). Greedy acceptance keeps
+the output EXACTLY the target's greedy decode — the correctness
+contract the tests pin — while the target takes ~(accepted+1) tokens
+per weight read instead of 1.
+
+TPU-shaped mechanics on the existing KV-cache decoder:
+  * verification reuses the decoder's prefill path (a T<=k+1 step is
+    one compiled program, MXU-batched over positions);
+  * REJECTION IS A POSITION REWIND: the cache masks attention by
+    absolute position (gpt.py _block), so stale K/V rows beyond `pos`
+    are never attended and the next write overwrites them — rollback
+    costs a scalar update, no buffer copies;
+  * the compiled step set is small and reused: T=1 (draft), T=k /
+    T=k+1 (verify with/without a pending token), T=prompt (prefill).
+
+The reference has no serving stack at all (it streams CNN frames,
+reference src/test.py:30-41); this joins the beyond-reference serving
+surface alongside dynamic batching and int8 weights.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def speculative_generate(
+    target: Any,
+    target_params: dict,
+    draft: Any,
+    draft_params: dict,
+    prompt_ids: jax.Array,
+    num_steps: int,
+    *,
+    k: int = 4,
+) -> tuple[jax.Array, dict]:
+    """Greedy speculative continuation of `prompt_ids` [1, T0].
+
+    Returns (ids [1, T0 + num_steps], stats): ids are bit-identical to
+    `target.generate(target_params, prompt_ids, num_steps)` at
+    temperature 0, and stats carries the speedup evidence —
+    `target_steps` (target weight reads taken, incl. prefill) vs
+    `plain_steps`, and `acceptance` (the FRACTION of proposed tokens
+    accepted, in [0, 1]; expected tokens per verify forward is
+    acceptance*k + 1). Batch 1 only: acceptance length varies per
+    element while the cache write head is one scalar.
+
+    Invariant kept across rounds: the target cache covers `ids` except
+    at most one trailing token; the draft cache covers `ids` except
+    EXACTLY one trailing token (so each proposal round starts by
+    feeding that token and reading the draft's next-token logits).
+    """
+    if prompt_ids.shape[0] != 1:
+        raise ValueError("speculative decoding is batch-1 (scalar rewind)")
+    if prompt_ids.shape[1] < 1:
+        raise ValueError("prompt must have at least one token")
+    if k < 1:
+        raise ValueError(f"k={k}: need at least one proposed token")
+    t0 = prompt_ids.shape[1]
+    for dec, name in ((target, "target"), (draft, "draft")):
+        # +k: a verify round may overshoot num_steps before trimming.
+        if t0 + num_steps + k > dec.cfg.max_len:
+            raise ValueError(
+                f"prompt {t0} + steps {num_steps} + k {k} exceeds the "
+                f"{name} max_len {dec.cfg.max_len}"
+            )
+
+    tstep = target.make_step()
+    dstep = draft.make_step()
+    tcache = target.init_cache(1)
+    dcache = draft.init_cache(1)
+
+    # Prefill: target on the full prompt (its last logits are
+    # P(next | prompt)); draft on all but the last token, establishing
+    # the one-token-behind invariant.
+    tlogits, tcache = tstep(target_params, tcache, prompt_ids)
+    last_logits = tlogits[:, -1, :]
+    if t0 > 1:
+        _, dcache = dstep(draft_params, dcache, prompt_ids[:, :-1])
+
+    ids = prompt_ids
+    target_steps = 1
+    rounds = 0
+    accepted_total = 0
+
+    while ids.shape[1] - t0 < num_steps:
+        n0 = ids.shape[1]
+        # 1. Draft proposes k tokens, starting from its missing last
+        #    accepted token (greedy draft).
+        feed = ids[:, -1:]
+        proposals = []
+        for _ in range(k):
+            dlg, dcache = dstep(draft_params, dcache, feed)
+            feed = jnp.argmax(dlg[:, -1, :], axis=-1)[:, None].astype(
+                ids.dtype
+            )
+            proposals.append(feed)
+        prop = jnp.concatenate(proposals, axis=1)  # [1, k]
+        # Draft cache now covers ids + p1..p_{k-1} (p_k never fed).
+
+        # 2. Target verifies in one forward: any not-yet-fed accepted
+        #    token (0 or 1 of them) + the k proposals.
+        t_missing = n0 - int(jax.device_get(tcache["pos"]))
+        assert t_missing in (0, 1), t_missing
+        verify_in = (
+            jnp.concatenate([ids[:, n0 - t_missing :], prop], axis=1)
+            if t_missing
+            else prop
+        )
+        vlogits, tcache = tstep(target_params, tcache, verify_in)
+        target_steps += 1
+        # Prediction for proposal j comes from the logits of the
+        # token before it: last_logits for p1 when nothing pended,
+        # else in-round logits.
+        base = last_logits if t_missing == 0 else vlogits[:, 0, :]
+        preds = jnp.concatenate(
+            [
+                jnp.argmax(base, axis=-1)[:, None],
+                jnp.argmax(
+                    vlogits[:, t_missing : t_missing + k - 1, :], axis=-1
+                ),
+            ],
+            axis=1,
+        ).astype(ids.dtype)  # [1, k]
+
+        matches = np.asarray(jax.device_get(preds[0] == prop[0]))
+        a = k if matches.all() else int(matches.argmin())
+        rounds += 1
+        accepted_total += a
+
+        if a == k:
+            new = prop
+            # Bonus: the verify forward already predicts the token
+            # after p_k.
+            last_logits = vlogits[:, t_missing + k - 1, :]
+        else:
+            # Target's own token replaces the first mismatch; it has
+            # not been fed, so it becomes the target's pending token
+            # (next round's base comes from in-round logits, so
+            # last_logits is dead until the caches catch up).
+            new = jnp.concatenate([prop[:, :a], preds[:, a : a + 1]], axis=1)
+        ids = jnp.concatenate([ids, new], axis=1)
+        n1 = ids.shape[1]
+
+        # 3. Rewind write heads past rejected rows (position-masked,
+        #    overwritten on the next write). Target covers n1 (full
+        #    accept) or n0+a (its pending corrected token is new[-1]);
+        #    draft always ends exactly one token behind ids.
+        if a < k:
+            tcache = {
+                **tcache,
+                "pos": jnp.asarray(n0 + a, tcache["pos"].dtype),
+            }
+        dcache = {
+            **dcache,
+            "pos": jnp.minimum(
+                dcache["pos"], jnp.asarray(n1 - 1, dcache["pos"].dtype)
+            ),
+        }
+
+    ids = ids[:, : t0 + num_steps]
+    stats = {
+        "target_steps": target_steps,
+        "plain_steps": num_steps,
+        "rounds": rounds,
+        "acceptance": accepted_total / max(1, rounds * k),
+    }
+    return ids, stats

@@ -303,20 +303,24 @@ def moe_ffn_a2a(
 ) -> jax.Array:
     """Top-1 MoE FFN with all-to-all expert dispatch on (B, S, D).
 
-    The scaling path dense dispatch can't reach: instead of every
-    device computing all its experts for all tokens, each token is
-    ROUTED — packed into a static (E, C, D) dispatch buffer (C =
-    capacity_factor x tokens/E, Switch-style; over-capacity tokens
-    fall through on the residual path with zero expert output), moved
-    to its expert's device by one `lax.all_to_all` over ep (ICI), run
-    through that device's experts only, and moved back by the inverse
-    all_to_all. Compute per device is E_local x (ep x C) tokens
-    regardless of E_global, and every shape is static.
+    The scaling path dense dispatch can't reach: each device along ep
+    takes ITS OWN 1/ep slice of the token stream (tokens arrive
+    replicated over ep in this stack, so the slice assigns real
+    ownership), routes the slice into a static (E, C, D) capacity
+    buffer (C = capacity_factor x slice_tokens / E, Switch-style;
+    over-capacity tokens fall through on the residual path), moves
+    each expert's slots to that expert's device with one
+    `lax.all_to_all` over ICI — carrying DISTINCT tokens per sender —
+    runs only the local experts, and returns outputs by the inverse
+    all_to_all. Per-device expert compute is capacity-bounded
+    (cf x N / E_global x E_local tokens) instead of dense's
+    N x E_local, and one psum reassembles the replicated output —
+    the same closing collective as the dense dispatch.
 
-    Routing matches moe_ffn exactly (same replicated router, global
-    softmax, top-1 + gate), so with C large enough to drop nothing the
-    two dispatches are numerically equivalent — that equivalence is
-    the correctness test.
+    Routing matches moe_ffn exactly (one shared _route_top1, per-token
+    decisions), so with C large enough to drop nothing the two
+    dispatches are numerically equivalent — that equivalence is the
+    correctness test.
     """
     import math
 
@@ -326,23 +330,31 @@ def moe_ffn_a2a(
     e_local = p["w1"].shape[0]
     ep = 1 if ep_axis is None else lax.axis_size(ep_axis)
     e_global = ep * e_local
-    cap = max(1, math.ceil(capacity_factor * n / e_global))
+    if n % ep:
+        raise ValueError(
+            f"a2a dispatch needs tokens ({n} = {b}x{s}) divisible by "
+            f"the expert axis size {ep}"
+        )
+    n_l = n // ep
+    cap = max(1, math.ceil(capacity_factor * n_l / e_global))
 
     xf = x.reshape(n, d)
-    top, gate = _route_top1(p["router"], xf)  # (N,) each
+    ep_idx = 0 if ep_axis is None else lax.axis_index(ep_axis)
+    x_own = lax.dynamic_slice_in_dim(xf, ep_idx * n_l, n_l)  # (n_l, D)
+    top, gate = _route_top1(p["router"], x_own)  # (n_l,) each
 
-    onehot = jax.nn.one_hot(top, e_global, dtype=jnp.int32)  # (N, E)
+    onehot = jax.nn.one_hot(top, e_global, dtype=jnp.int32)  # (n_l, E)
     # Arrival-order position of each token within its expert's queue;
     # tokens at position >= cap are dropped (Switch-style).
-    pos_in_e = jnp.cumsum(onehot, axis=0) - 1  # (N, E)
+    pos_in_e = jnp.cumsum(onehot, axis=0) - 1  # (n_l, E)
     keep = (pos_in_e < cap) & (onehot > 0)
     dispatch = (
         jax.nn.one_hot(pos_in_e, cap, dtype=jnp.float32)
         * keep[..., None]
-    )  # (N, E, C)
+    )  # (n_l, E, C)
     combine = dispatch * gate[:, None, None].astype(jnp.float32)
 
-    xin = jnp.einsum("nd,nec->ecd", xf.astype(jnp.float32), dispatch)
+    xin = jnp.einsum("nd,nec->ecd", x_own.astype(jnp.float32), dispatch)
     if ep_axis is not None:
         # (E, C, D) -> (E_local, ep*C, D): expert-group rows k go to
         # device k (split over the expert axis); the received sender
@@ -367,16 +379,18 @@ def moe_ffn_a2a(
             y, ep_axis, split_axis=1, concat_axis=0, tiled=True
         )
 
-    out = jnp.einsum("ecd,nec->nd", y.astype(jnp.float32), combine)
-    out = out.astype(dt).reshape(b, s, d)
-    if ep_axis is not None:
-        # Every device holds identical values here (tokens are
-        # replicated over ep, so each dispatched the same batch and
-        # received the same expert outputs back) — pmean closes the
-        # shard_map varying type to replicated, with the same
-        # collective profile as the dense dispatch's psum.
-        out = lax.pmean(out, ep_axis)
-    return out
+    out_own = jnp.einsum(
+        "ecd,nec->nd", y.astype(jnp.float32), combine
+    )  # (n_l, D) — expert outputs for THIS device's token slice
+    if ep_axis is None:
+        return out_own.astype(dt).reshape(b, s, d)
+    # Reassemble the replicated stream: each device contributes its
+    # slice, one psum (dense's closing collective) sums the disjoint
+    # contributions and returns the shard_map type to replicated.
+    out = jnp.zeros((n, d), jnp.float32)
+    out = lax.dynamic_update_slice(out, out_own, (ep_idx * n_l, 0))
+    out = lax.psum(out, ep_axis)
+    return out.astype(dt).reshape(b, s, d)
 
 
 def _layer_norm(x, scale, bias, eps):

@@ -1,0 +1,113 @@
+"""Speculative decoding: greedy output must be BIT-IDENTICAL to the
+target's own greedy decode, with fewer target forwards."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from defer_tpu.models.gpt import GptDecoder
+from defer_tpu.models.llama import llama_config, tiny_llama
+from defer_tpu.models.speculative import speculative_generate
+from defer_tpu.parallel.transformer_stack import TransformerConfig
+
+
+def _target():
+    return GptDecoder(
+        TransformerConfig(
+            num_layers=3,
+            dim=64,
+            num_heads=4,
+            ffn_dim=128,
+            vocab_size=96,
+            max_len=64,
+            norm_style="pre",
+        ),
+        compute_dtype=jnp.float32,
+    )
+
+
+def _draft():
+    # Smaller, independently initialized — realistic low-agreement
+    # draft with the same vocabulary.
+    return GptDecoder(
+        TransformerConfig(
+            num_layers=1,
+            dim=32,
+            num_heads=2,
+            ffn_dim=64,
+            vocab_size=96,
+            max_len=64,
+            norm_style="pre",
+        ),
+        compute_dtype=jnp.float32,
+    )
+
+
+@pytest.mark.parametrize("k", [1, 3, 4])
+def test_speculative_equals_target_greedy(k):
+    target, draft = _target(), _draft()
+    tp = target.init(jax.random.key(0))
+    dp = draft.init(jax.random.key(1))
+    prompt = jnp.asarray([[5, 17, 3]], jnp.int32)
+    steps = 12
+    want = target.generate(tp, prompt, steps)
+    got, stats = speculative_generate(target, tp, draft, dp, prompt, steps, k=k)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert got.shape == (1, 3 + steps)
+    assert stats["rounds"] >= 1
+
+
+def test_perfect_draft_amortizes_target_reads():
+    """With draft == target every proposal is accepted: k tokens per
+    target forward, so target_steps collapses to ~steps/k."""
+    target = _target()
+    tp = target.init(jax.random.key(0))
+    prompt = jnp.asarray([[1, 2]], jnp.int32)
+    steps, k = 12, 4
+    want = target.generate(tp, prompt, steps)
+    got, stats = speculative_generate(
+        target, tp, target, tp, prompt, steps, k=k
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert stats["acceptance"] == 1.0
+    # ceil(12/4)=3 verify rounds + 1 prefill.
+    assert stats["target_steps"] == 4
+    assert stats["target_steps"] < stats["plain_steps"]
+
+
+def test_speculative_llama_target():
+    """Cross-family: llama target (rope/GQA) with a gpt draft."""
+    target = tiny_llama(64)
+    draft = _draft()
+    draft = dataclasses.replace(
+        draft,
+        cfg=dataclasses.replace(draft.cfg, vocab_size=target.cfg.vocab_size),
+    )
+    tp = target.init(jax.random.key(0))
+    dp = draft.init(jax.random.key(1))
+    prompt = jnp.asarray([[7, 2, 9, 4]], jnp.int32)
+    steps = 10
+    want = target.generate(tp, prompt, steps)
+    got, _ = speculative_generate(target, tp, draft, dp, prompt, steps, k=3)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_speculative_input_validation():
+    target, draft = _target(), _draft()
+    tp = target.init(jax.random.key(0))
+    dp = draft.init(jax.random.key(1))
+    with pytest.raises(ValueError, match="batch-1"):
+        speculative_generate(
+            target, tp, draft, dp, jnp.zeros((2, 3), jnp.int32), 4
+        )
+    with pytest.raises(ValueError, match="k=0"):
+        speculative_generate(
+            target, tp, draft, dp, jnp.zeros((1, 3), jnp.int32), 4, k=0
+        )
+    with pytest.raises(ValueError, match="max_len"):
+        speculative_generate(
+            target, tp, draft, dp, jnp.zeros((1, 3), jnp.int32), 500
+        )
