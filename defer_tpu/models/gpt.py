@@ -142,10 +142,16 @@ class GptDecoder:
         GQA attends grouped: q reshapes to [B, Hkv, G, T, Dh] against
         the [B, Hkv, S, Dh] cache, so the shared KV head is READ once
         per group instead of materialized G times — decode is KV-cache
-        bandwidth bound, which is the whole point of GQA."""
+        bandwidth bound, which is the whole point of GQA.
+
+        `pos` is the cache write head: a scalar (all batch elements at
+        the same depth — generate/prefill), or a (B,) vector when
+        every slot sits at its own depth (continuous batching,
+        runtime/decode_server.py); the branch is trace-time static."""
         cfg = self.cfg
         dt = x.dtype
         dh = cfg.dim // cfg.num_heads
+        per_slot = getattr(pos, "ndim", 0) == 1
         from defer_tpu.models.quant import dequantize_leaf
 
         def W(name):
@@ -162,15 +168,27 @@ class GptDecoder:
         kf = bias(h @ W("wk"), "bk")
         vf = bias(h @ W("wv"), "bv")
         if cfg.pos_style == "rope":
-            positions = pos + jnp.arange(qf.shape[1])
+            steps_r = jnp.arange(qf.shape[1])
+            positions = (
+                pos[:, None] + steps_r[None] if per_slot else pos + steps_r
+            )
             qf = apply_rope(qf, dh, positions, cfg.rope_theta)
             kf = apply_rope(kf, dh, positions, cfg.rope_theta)
         q = self._split_heads(qf)
         k = self._split_heads(kf)
         v = self._split_heads(vf)
         # Write the T new K/V rows at the cache head.
-        k_cache = lax.dynamic_update_slice(k_cache, k, (0, 0, pos, 0))
-        v_cache = lax.dynamic_update_slice(v_cache, v, (0, 0, pos, 0))
+        if per_slot:
+            upd = jax.vmap(
+                lambda c, new, pb: lax.dynamic_update_slice(
+                    c, new, (0, pb, 0)
+                )
+            )
+            k_cache = upd(k_cache, k, pos)
+            v_cache = upd(v_cache, v, pos)
+        else:
+            k_cache = lax.dynamic_update_slice(k_cache, k, (0, 0, pos, 0))
+            v_cache = lax.dynamic_update_slice(v_cache, v, (0, 0, pos, 0))
 
         b, h_q, t, _ = q.shape
         hkv = k_cache.shape[1]
@@ -185,9 +203,15 @@ class GptDecoder:
         # Causal-by-position: query t (absolute pos+t) sees cache slot
         # j iff j <= pos + t; empty slots beyond the head are excluded
         # by the same test.
-        j = jnp.arange(s_max)[None, :]
-        tt = pos + jnp.arange(t)[:, None]
-        logits = jnp.where(j <= tt, logits, -jnp.inf)
+        j = jnp.arange(s_max)
+        if per_slot:
+            tt = pos[:, None] + jnp.arange(t)  # (B, T)
+            mask = j[None, None, :] <= tt[:, :, None]  # (B, T, S)
+            mask = mask[:, None, None, :, :]
+        else:
+            tt = pos + jnp.arange(t)[:, None]  # (T, 1)
+            mask = j[None, :] <= tt  # (T, S)
+        logits = jnp.where(mask, logits, -jnp.inf)
         weights = jax.nn.softmax(logits, axis=-1).astype(dt)
         attn = jnp.einsum("bkgts,bksd->bkgtd", weights, v_cache)
         attn = attn.reshape(b, h_q, t, dh)
@@ -254,6 +278,15 @@ class GptDecoder:
             if cfg.pos_style == "rope":
                 # Rotary positions enter inside each block's q/k.
                 x = emb.astype(cd)
+            elif getattr(pos, "ndim", 0) == 1:
+                # Per-slot depths (continuous batching): gather each
+                # element's own position rows.
+                posv = jnp.take(
+                    params["pos_embedding"],
+                    pos[:, None] + jnp.arange(t),
+                    axis=0,
+                )
+                x = (emb + posv).astype(cd)
             else:
                 posv = lax.dynamic_slice_in_dim(
                     params["pos_embedding"], pos, t, axis=0

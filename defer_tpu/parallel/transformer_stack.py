@@ -432,18 +432,22 @@ def apply_rope(
     q, GQA-narrow k, and tensor-parallel local shards. Pairing is the
     rotate-half convention (first half with second half), matching HF
     transformers' llama so checkpoints transplant bit-compatibly.
-    `positions` are the ABSOLUTE sequence positions of the T tokens
-    (decode passes cache_pos + arange(T), sequence-parallel shards
-    pass their global offsets)."""
+    `positions` are the ABSOLUTE sequence positions of the T tokens:
+    shape (T,) shared across the batch (decode passes cache_pos +
+    arange(T); sequence-parallel shards pass their global offsets) or
+    (B, T) per batch element (continuous batching, where every slot
+    sits at its own depth)."""
     b, t, d = x_flat.shape
     x = x_flat.reshape(b, t, d // head_dim, head_dim)
     half = head_dim // 2
     freqs = theta ** (
         -jnp.arange(0, half, dtype=jnp.float32) * 2.0 / head_dim
     )
-    ang = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # (T, half)
-    cos = jnp.cos(ang)[None, :, None, :]
-    sin = jnp.sin(ang)[None, :, None, :]
+    ang = positions.astype(jnp.float32)[..., None] * freqs  # (..., T, half)
+    if ang.ndim == 2:  # shared positions -> add the batch axis
+        ang = ang[None]
+    cos = jnp.cos(ang)[:, :, None, :]
+    sin = jnp.sin(ang)[:, :, None, :]
     x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(
         jnp.float32
     )

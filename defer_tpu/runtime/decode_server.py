@@ -1,0 +1,195 @@
+"""Continuous-batching decode server: admit requests into batch slots
+mid-flight.
+
+A plain batched `generate` convoys requests: the batch finishes when
+its LAST member does, and new arrivals wait for the whole batch. Here
+the decode batch is a set of SLOTS, each at its own depth — the cache
+write head is a (B,) position VECTOR (models/gpt.py `per_slot`), so
+one jitted (B, 1) step advances every active request regardless of
+age, and a finished slot is immediately re-admitted with the next
+queued request:
+
+  * admission = single-request prefill (prompt padded to a pow2
+    bucket, so the compiled-shape set stays tiny) whose K/V rows are
+    inserted into the slot's lane of the big cache; stale rows past
+    the slot's position are never attended (position masking) and are
+    overwritten as the slot advances;
+  * every decode tick is ONE weight read shared by all active slots —
+    exactly the batching economics decode wants (weights dominate,
+    models/gpt.py), now without convoy latency;
+  * shapes are static everywhere: max_batch slots, bucketed prefill,
+    (B, 1) ticks; inactive slots decode a dummy token into row 0 and
+    their position is pinned back to 0 after each tick.
+
+Greedy only, and each request's output is BIT-IDENTICAL to a solo
+`dec.generate` of that request — the correctness contract the tests
+pin. The reference's serving story is a fixed stream of identical
+CNN frames (reference src/test.py:30-41); this is the autoregressive
+counterpart, composing with runtime/batching.py's request coalescing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class _Slot:
+    req: int | None = None
+    remaining: int = 0
+    last: Any = None  # next token to feed, [1, 1]
+    toks: list | None = None
+
+
+class DecodeServer:
+    """Greedy continuous-batching decoder over `max_batch` slots."""
+
+    def __init__(
+        self,
+        dec: Any,
+        params: dict,
+        *,
+        max_batch: int = 4,
+    ):
+        self.dec = dec
+        self.params = params
+        self.B = max_batch
+        self.step = dec.make_step()  # batched ticks (donating)
+        cache = dec.init_cache(max_batch)
+        cache["pos"] = jnp.zeros((max_batch,), jnp.int32)
+        self.cache = cache
+        self.slots = [_Slot() for _ in range(max_batch)]
+        self.pending: list[tuple[int, jax.Array, int]] = []
+        self.done: dict[int, jax.Array] = {}
+        self._next_id = 0
+        self.ticks = 0
+        self.solo_steps = 0  # what per-request loops would have cost
+
+    # -- public API -------------------------------------------------------
+
+    def submit(self, prompt_ids: jax.Array, num_steps: int) -> int:
+        """Queue a request; returns its id (resolved in .done)."""
+        if prompt_ids.shape[0] != 1:
+            raise ValueError("submit one request at a time ([1, T])")
+        t0 = prompt_ids.shape[1]
+        if t0 < 1:
+            raise ValueError("prompt must have at least one token")
+        if num_steps < 1:
+            raise ValueError(
+                f"num_steps={num_steps}: need at least one generated "
+                "token (a non-positive count would never complete)"
+            )
+        if t0 + num_steps > self.dec.cfg.max_len:
+            raise ValueError(
+                f"prompt {t0} + steps {num_steps} exceeds max_len "
+                f"{self.dec.cfg.max_len}"
+            )
+        rid = self._next_id
+        self._next_id += 1
+        self.pending.append((rid, prompt_ids, num_steps))
+        self.solo_steps += num_steps
+        return rid
+
+    def run(self) -> dict[int, jax.Array]:
+        """Serve until every submitted request completes; returns
+        {request_id: ids [1, T0 + num_steps]}."""
+        while self.pending or any(s.req is not None for s in self.slots):
+            self._admit()
+            self._tick()
+        return self.done
+
+    # -- internals --------------------------------------------------------
+
+    def _admit(self) -> None:
+        for i, slot in enumerate(self.slots):
+            if slot.req is not None or not self.pending:
+                continue
+            rid, prompt, steps = self.pending.pop(0)
+            t0 = prompt.shape[1]
+            # Bucketed prefill keeps the compiled-shape set small.
+            pad = 1 << (t0 - 1).bit_length()
+            pad = min(pad, self.dec.cfg.max_len)
+            padded = jnp.concatenate(
+                [prompt, jnp.zeros((1, pad - t0), prompt.dtype)], axis=1
+            )
+            small = self.dec.init_cache(1)
+            logits, small = self.step(self.params, small, padded)
+            # Insert the lane: K/V rows land in slot i; rows past t0
+            # are stale but position-masked until overwritten.
+            self.cache = {
+                "k": jax.lax.dynamic_update_slice(
+                    self.cache["k"], small["k"], (0, i, 0, 0, 0)
+                ),
+                "v": jax.lax.dynamic_update_slice(
+                    self.cache["v"], small["v"], (0, i, 0, 0, 0)
+                ),
+                "pos": self.cache["pos"].at[i].set(t0),
+            }
+            first = jnp.argmax(logits[:, t0 - 1, :], axis=-1)[
+                :, None
+            ].astype(prompt.dtype)
+            slot.req = rid
+            slot.remaining = steps - 1
+            slot.last = first
+            slot.toks = [prompt, first]
+            if slot.remaining == 0:
+                self._finish(slot)
+
+    def _tick(self) -> None:
+        active = [s.req is not None for s in self.slots]
+        if not any(active):
+            return
+        feed = jnp.concatenate(
+            [
+                s.last
+                if s.req is not None
+                else jnp.zeros((1, 1), jnp.int32)
+                for s in self.slots
+            ],
+            axis=0,
+        )
+        logits, cache = self.step(self.params, self.cache, feed)
+        self.ticks += 1
+        # Inactive slots wrote a dummy row at their position; pin them
+        # back to 0 so they never creep toward max_len.
+        mask = jnp.asarray(active)
+        cache = {**cache, "pos": jnp.where(mask, cache["pos"], 0)}
+        self.cache = cache
+        nxt = jnp.argmax(logits[:, -1, :], axis=-1)  # (B,)
+        for i, slot in enumerate(self.slots):
+            if slot.req is None:
+                continue
+            tok = nxt[i][None, None].astype(slot.last.dtype)
+            slot.last = tok
+            slot.toks.append(tok)
+            slot.remaining -= 1
+            if slot.remaining == 0:
+                self._finish(slot)
+
+    def _finish(self, slot: _Slot) -> None:
+        self.done[slot.req] = jnp.concatenate(slot.toks, axis=1)
+        slot.req = None
+        slot.toks = None
+        slot.last = None
+
+
+def serve_greedy(
+    dec: Any,
+    params: dict,
+    requests: list[tuple[jax.Array, int]],
+    *,
+    max_batch: int = 4,
+) -> tuple[list[jax.Array], dict]:
+    """One-shot convenience: serve `[(prompt, steps), ...]`, returning
+    outputs in submission order plus stats (`ticks` batched decode
+    steps taken vs `solo_steps` a per-request loop would take)."""
+    srv = DecodeServer(dec, params, max_batch=max_batch)
+    rids = [srv.submit(p, s) for p, s in requests]
+    done = srv.run()
+    stats = {"ticks": srv.ticks, "solo_steps": srv.solo_steps}
+    return [done[r] for r in rids], stats
