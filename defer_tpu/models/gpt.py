@@ -345,6 +345,58 @@ class GptDecoder:
 
     # -- generation --------------------------------------------------------
 
+    def prefill(
+        self,
+        params: dict,
+        cache: dict,
+        ids: jax.Array,
+        *,
+        chunk: int | None = None,
+    ) -> tuple[jax.Array, dict]:
+        """Consume a [B, T] prompt into the cache; returns
+        (last_logits [B, V], cache).
+
+        chunk=None runs one T-length step. A chunk size processes the
+        prompt in fixed-size pieces instead: peak activation memory is
+        O(chunk x T) rather than O(T^2) for the attention logits, and
+        ONE compiled shape serves any prompt length (the tail piece is
+        zero-padded; padded rows sit beyond the advanced position, so
+        they are never attended and later writes overwrite them)."""
+        t0 = ids.shape[1]
+        if t0 > self.cfg.max_len:
+            raise ValueError(
+                f"prompt {t0} exceeds max_len {self.cfg.max_len}"
+            )
+        step = self.make_step()
+        if chunk is None or chunk >= t0:
+            logits, cache = step(params, cache, ids)
+            return logits[:, -1, :], cache
+        if chunk < 1:
+            raise ValueError(f"chunk={chunk} must be >= 1")
+        last = None
+        for start in range(0, t0, chunk):
+            piece = ids[:, start : start + chunk]
+            real = piece.shape[1]
+            # Pad the tail piece to the fixed chunk shape — but only
+            # when the padded write stays inside the cache:
+            # dynamic_update_slice CLAMPS an out-of-range start, which
+            # would silently shift the write over earlier rows. At the
+            # boundary, feed the short tail as its own compiled shape.
+            if real < chunk and start + chunk <= self.cfg.max_len:
+                piece = jnp.concatenate(
+                    [
+                        piece,
+                        jnp.zeros((ids.shape[0], chunk - real), ids.dtype),
+                    ],
+                    axis=1,
+                )
+            logits, cache = step(params, cache, piece)
+            last = logits[:, real - 1, :]
+            if piece.shape[1] > real:
+                # Rewind the write head past the padded rows.
+                cache = {**cache, "pos": cache["pos"] - (chunk - real)}
+        return last, cache
+
     def generate(
         self,
         params: dict,
@@ -353,10 +405,12 @@ class GptDecoder:
         *,
         temperature: float = 0.0,
         rng: jax.Array | None = None,
+        prefill_chunk: int | None = None,
     ) -> jax.Array:
         """Greedy (temperature 0) or sampled continuation of
         `prompt_ids` [B, T0]; returns [B, T0 + num_steps]. Prefill runs
-        the whole prompt in one step; each new token reuses the
+        the whole prompt in one step (or fixed `prefill_chunk` pieces
+        for long prompts — see prefill); each new token reuses the
         compiled T=1 step with donated cache."""
         cfg = self.cfg
         b, t0 = prompt_ids.shape
@@ -367,9 +421,10 @@ class GptDecoder:
             )
         step = self.make_step()
         cache = self.init_cache(b)
-        logits, cache = step(params, cache, prompt_ids)
+        last, cache = self.prefill(
+            params, cache, prompt_ids, chunk=prefill_chunk
+        )
         ids = prompt_ids
-        last = logits[:, -1, :]
         if rng is None:
             rng = jax.random.key(0)
         for i in range(num_steps):

@@ -381,3 +381,51 @@ def test_cast_params_decode_matches_fp32_tokens():
     want16 = dec16.generate(params, prompt, 5)
     np.testing.assert_array_equal(np.asarray(got16), np.asarray(want16))
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_chunked_prefill_matches_full():
+    """Fixed-chunk prefill (incl. a zero-padded tail piece + position
+    rewind) must reproduce the one-shot prefill logits and the whole
+    greedy generation, for both position styles."""
+    from defer_tpu.models.gpt import tiny_gpt
+    from defer_tpu.models.llama import tiny_llama
+
+    for dec in (tiny_gpt(64), tiny_llama(64)):
+        params = dec.init(jax.random.key(0))
+        ids = jax.random.randint(
+            jax.random.key(1), (2, 11), 0, dec.cfg.vocab_size
+        )
+        full_last, _ = dec.prefill(params, dec.init_cache(2), ids)
+        for chunk in (1, 4, 16):
+            last, cache = dec.prefill(
+                params, dec.init_cache(2), ids, chunk=chunk
+            )
+            assert int(jax.device_get(cache["pos"])) == 11
+            np.testing.assert_allclose(
+                np.asarray(last),
+                np.asarray(full_last),
+                rtol=2e-4,
+                atol=2e-5,
+                err_msg=f"chunk={chunk}",
+            )
+        want = dec.generate(params, ids, 5)
+        got = dec.generate(params, ids, 5, prefill_chunk=4)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_chunked_prefill_at_max_len_boundary():
+    """The padded tail must never clamp-write over earlier cache rows:
+    with max_len=12, t0=11, chunk=5 the tail is fed unpadded, and the
+    generation equals the unchunked one exactly."""
+    from defer_tpu.models.gpt import tiny_gpt
+
+    dec = tiny_gpt(seq_len=12)
+    params = dec.init(jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (1, 11), 0, 128)
+    want = dec.generate(params, ids, 1)
+    got = dec.generate(params, ids, 1, prefill_chunk=5)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    with pytest.raises(ValueError, match="exceeds max_len"):
+        dec.prefill(
+            params, dec.init_cache(1), jnp.zeros((1, 13), jnp.int32)
+        )
