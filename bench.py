@@ -369,6 +369,69 @@ def _bench_decode(devices, cfg, label: str, with_int8: bool = False) -> dict:
     return rec
 
 
+def bench_decode_server(devices) -> dict:
+    """Continuous batching (runtime/decode_server.py): a mixed stream
+    of requests through 4 slots on the ~1B llama shape — the serving
+    number a per-request loop cannot reach (`tick_sharing` = solo
+    steps per batched weight read)."""
+    import jax
+
+    from defer_tpu.models.gpt import GptDecoder
+    from defer_tpu.models.llama import llama_config
+    from defer_tpu.runtime.decode_server import DecodeServer
+
+    import jax.numpy as jnp
+
+    cfg = llama_config(
+        num_layers=16,
+        dim=2048,
+        num_heads=16,
+        num_kv_heads=4,
+        ffn_dim=5632,
+        vocab_size=32000,
+        max_len=512,
+    )
+    dec = GptDecoder(cfg, compute_dtype=jnp.bfloat16)
+    params = jax.device_put(
+        dec.cast_params(dec.init(jax.random.key(0))), devices[0]
+    )
+
+    def requests():
+        reqs = []
+        for i in range(12):
+            t0 = 16 + (i * 23) % 112
+            steps = 16 + (i * 11) % 48
+            prompt = jax.random.randint(
+                jax.random.fold_in(jax.random.key(1), i),
+                (1, t0),
+                0,
+                cfg.vocab_size,
+            )
+            reqs.append((prompt, steps))
+        return reqs
+
+    def run() -> tuple[float, Any]:
+        srv = DecodeServer(dec, params, max_batch=4)
+        rids = [srv.submit(p, s) for p, s in requests()]
+        t0 = time.perf_counter()
+        done = srv.run()
+        jax.block_until_ready(done[rids[-1]])
+        return time.perf_counter() - t0, srv
+
+    run()  # compile pass (prefill buckets + tick shape)
+    dt, srv = run()
+    total = srv.solo_steps
+    rec = {
+        "requests": 12,
+        "slots": 4,
+        "tokens_per_sec": round(total / dt, 1),
+        "ticks": srv.ticks,
+        "tick_sharing": round(total / max(1, srv.ticks), 2),
+    }
+    log(f"decode server (llama-1b, continuous batching): {rec}")
+    return rec
+
+
 def bench_bert(devices) -> dict:
     """Single-chip SPMD BERT-base forward throughput + MFU."""
     import jax
@@ -595,6 +658,7 @@ def run_bench() -> dict:
         "vit_s16": None,
         "gpt_decode": None,
         "llama_decode": None,
+        "decode_server": None,
         "pallas_attention": None,
     }
     snapshot(result)
@@ -737,6 +801,7 @@ def run_bench() -> dict:
             ("vit_s16", bench_vit),
             ("gpt_decode", bench_gpt_decode),
             ("llama_decode", bench_llama_decode),
+            ("decode_server", bench_decode_server),
             ("bert_base", bench_bert),
         ]
         # Mosaic-kernel section last. It runs wherever the pallas gate

@@ -359,16 +359,25 @@ class GptDecoder:
         chunk=None runs one T-length step. A chunk size processes the
         prompt in fixed-size pieces instead: peak activation memory is
         O(chunk x T) rather than O(T^2) for the attention logits, and
-        ONE compiled shape serves any prompt length (the tail piece is
-        zero-padded; padded rows sit beyond the advanced position, so
-        they are never attended and later writes overwrite them)."""
+        ONE compiled shape serves any prompt length — short prompts
+        and tail pieces are zero-padded to the chunk (padded rows sit
+        beyond the advanced position, so they are never attended and
+        later writes overwrite them). Works on a warm cache: all
+        bounds are taken from the cache's actual write head."""
         t0 = ids.shape[1]
-        if t0 > self.cfg.max_len:
+        if getattr(cache["pos"], "ndim", 0) != 0:
             raise ValueError(
-                f"prompt {t0} exceeds max_len {self.cfg.max_len}"
+                "prefill needs a scalar-position cache (per-slot "
+                "caches admit through runtime/decode_server.py)"
+            )
+        base = int(jax.device_get(cache["pos"]))
+        if base + t0 > self.cfg.max_len:
+            raise ValueError(
+                f"cache position {base} + prompt {t0} exceeds max_len "
+                f"{self.cfg.max_len}"
             )
         step = self.make_step()
-        if chunk is None or chunk >= t0:
+        if chunk is None:
             logits, cache = step(params, cache, ids)
             return logits[:, -1, :], cache
         if chunk < 1:
@@ -377,12 +386,12 @@ class GptDecoder:
         for start in range(0, t0, chunk):
             piece = ids[:, start : start + chunk]
             real = piece.shape[1]
-            # Pad the tail piece to the fixed chunk shape — but only
-            # when the padded write stays inside the cache:
+            # Pad short/tail pieces to the fixed chunk shape — but
+            # only when the padded write stays inside the cache:
             # dynamic_update_slice CLAMPS an out-of-range start, which
             # would silently shift the write over earlier rows. At the
-            # boundary, feed the short tail as its own compiled shape.
-            if real < chunk and start + chunk <= self.cfg.max_len:
+            # boundary, feed the short piece as its own compiled shape.
+            if real < chunk and base + start + chunk <= self.cfg.max_len:
                 piece = jnp.concatenate(
                     [
                         piece,

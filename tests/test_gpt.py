@@ -429,3 +429,30 @@ def test_chunked_prefill_at_max_len_boundary():
         dec.prefill(
             params, dec.init_cache(1), jnp.zeros((1, 13), jnp.int32)
         )
+
+
+def test_chunked_prefill_on_warm_cache():
+    """prefill bounds come from the cache's real write head: a warm
+    cache near max_len must reject overflow and never clamp-write, and
+    a valid warm continuation must match the one-shot equivalent."""
+    from defer_tpu.models.gpt import tiny_gpt
+
+    dec = tiny_gpt(seq_len=32)
+    params = dec.init(jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (1, 30), 0, 128)
+
+    # One-shot over the full 30 tokens is the oracle.
+    want, _ = dec.prefill(params, dec.init_cache(1), ids)
+
+    # Warm path: 26 tokens in, then a 4-token chunked continuation
+    # whose padded piece would cross max_len=32 (26+4+... the guard
+    # must feed it unpadded).
+    _, cache = dec.prefill(params, dec.init_cache(1), ids[:, :26])
+    got, cache = dec.prefill(params, cache, ids[:, 26:], chunk=3)
+    assert int(jax.device_get(cache["pos"])) == 30
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5
+    )
+
+    with pytest.raises(ValueError, match="cache position"):
+        dec.prefill(params, cache, jnp.zeros((1, 5), jnp.int32))
