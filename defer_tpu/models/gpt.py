@@ -265,14 +265,20 @@ class GptDecoder:
             else:
                 # Vocab-row sharding: this shard owns rows
                 # [v0, v0 + V_local); out-of-range ids contribute
-                # zeros and the psum assembles full embeddings.
-                v_local = table.shape[0]
+                # zeros and the psum assembles full embeddings. An
+                # int8 table gathers its q rows and widens just the
+                # gathered slice.
+                quant = isinstance(table, dict) and "q" in table
+                rows = table["q"] if quant else table
+                v_local = rows.shape[0]
                 v0 = lax.axis_index(tp_axis) * v_local
                 local_ids = ids - v0
                 in_range = (local_ids >= 0) & (local_ids < v_local)
                 emb = jnp.take(
-                    table, jnp.clip(local_ids, 0, v_local - 1), axis=0
+                    rows, jnp.clip(local_ids, 0, v_local - 1), axis=0
                 )
+                if quant:
+                    emb = emb.astype(jnp.float32) * table["s"]
                 emb = jnp.where(in_range[..., None], emb, 0.0)
                 emb = lax.psum(emb, tp_axis)
             if cfg.pos_style == "rope":
@@ -328,12 +334,17 @@ class GptDecoder:
 
         return step
 
+    def _memo_key(self, donate: bool):
+        """Memo key for make_step; subclasses extend it when the
+        compiled step depends on more than the donate flag."""
+        return donate
+
     def _memoized(self, donate: bool, build):
         from defer_tpu.utils.memo import cached_step
 
         return cached_step(
             self,
-            donate,
+            self._memo_key(donate),
             lambda: jax.jit(build(), donate_argnums=(1,) if donate else ()),
         )
 
@@ -474,6 +485,16 @@ class SpmdGptDecoder(GptDecoder):
 
     mesh: Any = None
     tp_axis: str = "model"
+
+    def _memo_key(self, donate: bool):
+        # The sharded step's in_specs depend on which param leaves are
+        # int8 trees (set by shard_params) — key the memo on that too,
+        # or a step built before shard_params would keep stale specs.
+        return (
+            donate,
+            getattr(self, "_quantized_emb", False),
+            getattr(self, "_quantized_keys", frozenset()),
+        )
     # Optional batch sharding: set to a mesh axis name (e.g. "data")
     # to shard the cache/ids/logits batch dim over it — dp x tp
     # serving in one program.
@@ -518,12 +539,36 @@ class SpmdGptDecoder(GptDecoder):
         from jax.sharding import PartitionSpec as P
 
         tp = self.tp_axis
+        stack = stack_specs(None, tp, cfg=self.cfg)
+        emb_spec = P(tp, None)
+        qkeys = getattr(self, "_quantized_keys", frozenset())
+        if qkeys:
+
+            def qwrap(spec: P) -> dict:
+                # The scale is keepdims-shaped like q with middle axes
+                # of size 1: shard only the leading (layer) and
+                # trailing (channel) axes the way q does.
+                n = len(spec)
+                s_spec = (
+                    P(spec[0], *([None] * (n - 2)), spec[-1])
+                    if n >= 3
+                    else P(None, spec[-1])
+                )
+                return {"q": spec, "s": s_spec}
+
+            stack = {
+                k: qwrap(v) if k in qkeys else v for k, v in stack.items()
+            }
+        if getattr(self, "_quantized_emb", False):
+            # Vocab-sharded int8 table: rows over tp, per-channel
+            # scales replicated (they span D, not vocab).
+            emb_spec = {"q": P(tp, None), "s": P(None, None)}
         specs = {
             # Megatron vocab sharding: embedding rows over tp; the
             # tied head reuses the same shards.
-            "token_embedding": P(tp, None),
+            "token_embedding": emb_spec,
             "final_ln_scale": P(),
-            "stack": stack_specs(None, tp, cfg=self.cfg),
+            "stack": stack,
         }
         if self.cfg.pos_style == "learned":
             specs["pos_embedding"] = P()
@@ -544,21 +589,27 @@ class SpmdGptDecoder(GptDecoder):
                 "parallelism yet — the single-device GptDecoder serves "
                 "untied checkpoints"
             )
-        if any(
-            isinstance(v, dict) and "q" in v
-            for v in [params["token_embedding"], *params["stack"].values()]
-        ):
-            raise NotImplementedError(
-                "int8-quantized params are not supported under tensor "
-                "parallelism yet — the single-device GptDecoder serves "
-                "quantized checkpoints"
-            )
+        # Weight-only int8 trees (models/quant.py) shard like their
+        # float counterparts: q takes the weight's spec, the
+        # per-channel scale replicates its size-1 axes. Record which
+        # leaves are quantized BEFORE _specs/make_step so the step's
+        # in_specs match the tree (and key the step memo on it).
+        self._quantized_keys = frozenset(
+            k
+            for k, v in params["stack"].items()
+            if isinstance(v, dict) and "q" in v
+        )
         emb = params["token_embedding"]
-        pad = self._vocab_padded - emb.shape[0]
+        self._quantized_emb = isinstance(emb, dict) and "q" in emb
+        rows = emb["q"] if self._quantized_emb else emb
+        pad = self._vocab_padded - rows.shape[0]
         if pad:
+            padded = jnp.pad(rows, ((0, pad), (0, 0)))
             params = {
                 **params,
-                "token_embedding": jnp.pad(emb, ((0, pad), (0, 0))),
+                "token_embedding": {"q": padded, "s": emb["s"]}
+                if self._quantized_emb
+                else padded,
             }
         return jax.device_put(
             params,

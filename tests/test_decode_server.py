@@ -66,3 +66,17 @@ def test_submit_validation():
         srv.submit(jnp.zeros((1, 3), jnp.int32), 64)
     with pytest.raises(ValueError, match="num_steps"):
         srv.submit(jnp.zeros((1, 3), jnp.int32), 0)
+
+
+def test_server_serves_int8_params():
+    """Continuous batching composes with weight-only int8: quantized
+    param trees flow through per-slot ticks unchanged."""
+    from defer_tpu.models.quant import quantize_decoder_params
+
+    dec = tiny_llama(64)
+    params = quantize_decoder_params(dec.init(jax.random.key(0)))
+    reqs = _requests(dec.cfg.vocab_size)[:3]
+    outs, _ = serve_greedy(dec, params, reqs, max_batch=2)
+    for (prompt, steps), got in zip(reqs, outs):
+        want = dec.generate(params, prompt, steps)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

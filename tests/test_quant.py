@@ -1,5 +1,6 @@
 """Weight-only int8 decode serving (models/quant.py): quantization
-error bounds, end-to-end decode fidelity, and the tp guard."""
+error bounds, end-to-end decode fidelity, and tensor-parallel parity
+(int8 trees shard like their float counterparts)."""
 
 import jax
 import jax.numpy as jnp
@@ -67,7 +68,12 @@ def test_int8_decode_tracks_full_precision():
     assert (np.asarray(out) >= 0).all()
 
 
-def test_int8_rejected_under_tp(devices):
+def test_int8_decode_under_tp_matches_single_device(devices):
+    """int8 trees shard like their float counterparts (q takes the
+    weight's spec, scales replicate their size-1 axes; vocab-padded
+    int8 table): tp=2 quantized decode produces the single-device
+    quantized tokens."""
+    from defer_tpu.models.gpt import GptDecoder
     from defer_tpu.models.llama import llama_config, spmd_llama
     from defer_tpu.parallel.mesh import make_mesh
 
@@ -77,11 +83,20 @@ def test_int8_rejected_under_tp(devices):
         num_heads=4,
         num_kv_heads=2,
         ffn_dim=128,
-        vocab_size=64,
+        vocab_size=97,  # exercises the padded int8 table
         max_len=16,
     )
+    single = GptDecoder(cfg, compute_dtype=jnp.float32)
+    params = single.init(jax.random.key(0))
+    qparams = quantize_decoder_params(params)
+    prompt = jnp.zeros((1, 3), jnp.int32)
+    want = single.generate(qparams, prompt, 5)
+
     mesh = make_mesh({"model": 2}, devices[:2])
     dec = spmd_llama(mesh, cfg, compute_dtype=jnp.float32)
-    qparams = quantize_decoder_params(dec.init(jax.random.key(0)))
-    with pytest.raises(NotImplementedError, match="quantized"):
-        dec.shard_params(qparams)
+    sharded = dec.shard_params(quantize_decoder_params(params))
+    assert sharded["token_embedding"]["q"].shape == (98, 64)  # padded
+    wq = sharded["stack"]["wq"]["q"]
+    assert {s.data.shape for s in wq.addressable_shards} == {(2, 64, 32)}
+    got = dec.generate(sharded, prompt, 5)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
