@@ -52,6 +52,11 @@ class TransformerConfig:
     # this makes the SPMD stack a trainable GPT — the same params the
     # KV-cache decoder (defer_tpu/models/gpt.py) serves.
     causal: bool = False
+    # Rematerialize each block on the backward pass (jax.checkpoint):
+    # activation memory drops from O(layers) to O(1) blocks per stage
+    # at the cost of one extra forward — the standard TPU trade when
+    # HBM, not FLOPs, bounds the trainable model size.
+    remat: bool = False
     # MoE dispatch: "dense" computes every local expert for every
     # token and masks (exact, no drops, E_local x the FLOPs); "a2a"
     # routes tokens to their expert's device with lax.all_to_all under
@@ -577,21 +582,32 @@ def layers_apply(
     ep_axis: str | None = None,
 ) -> jax.Array:
     """Apply a [Llocal, ...]-stacked group of blocks via lax.scan (one
-    compiled block body regardless of depth — compiler-friendly)."""
+    compiled block body regardless of depth — compiler-friendly).
+    cfg.remat wraps the block in jax.checkpoint: the scan then saves
+    only each block's INPUT for the backward pass and recomputes the
+    block internals, so activation memory per stage stays O(1) blocks
+    (collectives inside the block — psum/all_to_all/ppermute — are
+    replayed too, which XLA handles)."""
+
+    def block(p_one, h):
+        return block_apply(
+            p_one,
+            h,
+            cfg,
+            tp_axis=tp_axis,
+            sp_axis=sp_axis,
+            sp_strategy=sp_strategy,
+            ep_axis=ep_axis,
+        )
+
+    if cfg.remat:
+        # prevent_cse=False: scan's staging already rules out the CSE
+        # that flag guards against, and the default's optimization
+        # barriers would block XLA fusion inside every block.
+        block = jax.checkpoint(block, prevent_cse=False)
 
     def body(h, p_one):
-        return (
-            block_apply(
-                p_one,
-                h,
-                cfg,
-                tp_axis=tp_axis,
-                sp_axis=sp_axis,
-                sp_strategy=sp_strategy,
-                ep_axis=ep_axis,
-            ),
-            None,
-        )
+        return block(p_one, h), None
 
     out, _ = lax.scan(body, x, stacked)
     return out

@@ -49,6 +49,12 @@ def main() -> None:
         "--ckpt-every steps and resume from it if present",
     )
     ap.add_argument("--ckpt-every", type=int, default=10)
+    ap.add_argument(
+        "--remat",
+        action="store_true",
+        help="rematerialize blocks on backward (jax.checkpoint): "
+        "O(1)-block activation memory per stage for one extra forward",
+    )
     args = ap.parse_args()
 
     n_dev = len(jax.devices())
@@ -64,6 +70,7 @@ def main() -> None:
         ffn_dim=4 * args.dim,
         vocab_size=1024,
         max_len=args.seq,
+        remat=args.remat,
     )
     sb = SpmdBert(mesh, cfg)
     init_state, train_step = make_train_step(
