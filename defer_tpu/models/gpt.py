@@ -202,15 +202,20 @@ class GptDecoder:
         ) * (dh**-0.5)
         # Causal-by-position: query t (absolute pos+t) sees cache slot
         # j iff j <= pos + t; empty slots beyond the head are excluded
-        # by the same test.
+        # by the same test. A sliding window additionally drops slots
+        # more than `window`-1 behind the query (Mistral-style).
         j = jnp.arange(s_max)
         if per_slot:
             tt = pos[:, None] + jnp.arange(t)  # (B, T)
             mask = j[None, None, :] <= tt[:, :, None]  # (B, T, S)
+            if cfg.window is not None:
+                mask &= j[None, None, :] > tt[:, :, None] - cfg.window
             mask = mask[:, None, None, :, :]
         else:
             tt = pos + jnp.arange(t)[:, None]  # (T, 1)
             mask = j[None, :] <= tt  # (T, S)
+            if cfg.window is not None:
+                mask &= j[None, :] > tt - cfg.window
         logits = jnp.where(mask, logits, -jnp.inf)
         weights = jax.nn.softmax(logits, axis=-1).astype(dt)
         attn = jnp.einsum("bkgts,bksd->bkgtd", weights, v_cache)

@@ -37,6 +37,7 @@ def llama_config(
     max_len: int = 4096,
     rope_theta: float = 10000.0,
     eps: float = 1e-5,
+    window: int | None = None,
 ) -> TransformerConfig:
     """The llama architecture as a TransformerConfig (defaults are
     7B-class shapes; tests use tiny ones)."""
@@ -56,7 +57,18 @@ def llama_config(
         use_bias=False,
         rope_theta=rope_theta,
         causal=True,
+        window=window,
     )
+
+
+def mistral_config(**kw) -> TransformerConfig:
+    """Mistral = the llama architecture + sliding-window attention
+    (each position attends its last `window` predecessors; default
+    4096 as in Mistral-7B). Checkpoints transplant through the same
+    `from_hf_state_dict` — HF MistralForCausalLM uses identical
+    parameter names."""
+    kw.setdefault("window", 4096)
+    return llama_config(**kw)
 
 
 def tiny_llama(seq_len: int = 32) -> GptDecoder:

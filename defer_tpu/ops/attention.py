@@ -31,8 +31,14 @@ def attention_reference(
     *,
     bias: jax.Array | None = None,
     causal: bool = False,
+    window: int | None = None,
 ) -> jax.Array:
-    """Plain softmax attention on (B, H, S, Dh) tensors, fp32 softmax."""
+    """Plain softmax attention on (B, H, S, Dh) tensors, fp32 softmax.
+
+    window=W adds Mistral-style sliding-window masking: query position
+    p attends key positions (p-W, p] only (requires causal=True)."""
+    if window is not None and not causal:
+        raise NotImplementedError("window requires causal attention")
     dh = q.shape[-1]
     logits = jnp.einsum(
         "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
@@ -41,7 +47,11 @@ def attention_reference(
         logits = logits + bias.astype(jnp.float32)
     if causal:
         s_q, s_k = logits.shape[-2:]
-        mask = jnp.tril(jnp.ones((s_q, s_k), jnp.bool_), k=s_k - s_q)
+        qpos = jnp.arange(s_q)[:, None] + (s_k - s_q)
+        kpos = jnp.arange(s_k)[None, :]
+        mask = kpos <= qpos
+        if window is not None:
+            mask &= kpos > qpos - window
         logits = jnp.where(mask, logits, -jnp.inf)
     weights = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", weights, v)
@@ -97,6 +107,7 @@ def multi_head_attention(
     num_heads: int,
     bias: jax.Array | None = None,
     causal: bool = False,
+    window: int | None = None,
     use_pallas: Any = "auto",
     sp_axis: str | None = None,
     sp_strategy: str = "ring",
@@ -105,6 +116,9 @@ def multi_head_attention(
 
     use_pallas: True / False / "auto" (pallas iff running on TPU and the
     shape is tile-friendly).
+
+    window: sliding-window (Mistral-style) masking, causal only; the
+    pallas path doesn't implement it, so it forces the XLA reference.
 
     sp_axis: mesh axis name for sequence parallelism — S is then the
     LOCAL sequence shard and attention runs ring / Ulysses over that
@@ -116,6 +130,11 @@ def multi_head_attention(
             raise NotImplementedError(
                 "bias is not supported under sequence parallelism"
             )
+        if window is not None:
+            raise NotImplementedError(
+                "sliding-window attention is not supported under "
+                "sequence parallelism yet"
+            )
         from defer_tpu.parallel.sequence import sequence_attention
 
         return _merge_heads(
@@ -126,9 +145,14 @@ def multi_head_attention(
                 causal=causal,
             )
         )
+    if use_pallas is True and window is not None:
+        raise NotImplementedError(
+            "the pallas flash kernel does not implement sliding-window "
+            "masking; use use_pallas='auto' or False with window"
+        )
     want_pallas = (
         use_pallas is True or (use_pallas == "auto" and _pallas_available())
-    )
+    ) and window is None
     if want_pallas and bias is None:
         try:
             from defer_tpu.ops.pallas_attention import flash_attention
@@ -147,4 +171,8 @@ def multi_head_attention(
                     # An explicit request must not silently degrade.
                     raise
                 # "auto": fall back to the XLA path.
-    return _merge_heads(attention_reference(qh, kh, vh, bias=bias, causal=causal))
+    return _merge_heads(
+        attention_reference(
+            qh, kh, vh, bias=bias, causal=causal, window=window
+        )
+    )

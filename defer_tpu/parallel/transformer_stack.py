@@ -52,6 +52,9 @@ class TransformerConfig:
     # this makes the SPMD stack a trainable GPT — the same params the
     # KV-cache decoder (defer_tpu/models/gpt.py) serves.
     causal: bool = False
+    # Sliding-window (Mistral-style) causal attention: each position
+    # attends at most `window` predecessors. None = full causal.
+    window: int | None = None
     # Rematerialize each block on the backward pass (jax.checkpoint):
     # activation memory drops from O(layers) to O(1) blocks per stage
     # at the cost of one extra forward — the standard TPU trade when
@@ -87,6 +90,12 @@ class TransformerConfig:
             )
         if self.ffn_style == "swiglu" and self.num_experts:
             raise ValueError("swiglu MoE blocks are not supported")
+        if self.window is not None and (
+            self.window < 1 or not self.causal
+        ):
+            raise ValueError(
+                f"window={self.window} needs causal=True and window >= 1"
+            )
         if self.capacity_factor <= 0:
             raise ValueError(
                 f"capacity_factor={self.capacity_factor} must be > 0 "
@@ -527,6 +536,7 @@ def block_apply(
         v,
         num_heads=local_heads,
         causal=cfg.causal,
+        window=cfg.window,
         use_pallas="auto",
         sp_axis=sp_axis,
         sp_strategy=sp_strategy,
