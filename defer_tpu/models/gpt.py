@@ -61,10 +61,19 @@ def sample_token(
 
 @dataclasses.dataclass
 class GptDecoder:
-    """Decoder-only transformer with weight-tied output head."""
+    """Decoder-only transformer with weight-tied output head.
+
+    rolling_cache=True (sliding-window models only, rotary positions):
+    the KV cache holds cfg.window slots instead of cfg.max_len, each
+    new row overwriting slot position%W — cache memory is bounded by
+    the window and generation length becomes unbounded. Attention runs
+    over [cache, current-step keys] with explicit absolute positions,
+    so a multi-token (prefill) step never loses in-window keys to
+    same-step overwrites."""
 
     cfg: TransformerConfig
     compute_dtype: Any = jnp.bfloat16
+    rolling_cache: bool = False
 
     def __post_init__(self):
         if self.cfg.norm_style != "pre":
@@ -73,6 +82,14 @@ class GptDecoder:
             )
         if self.cfg.num_experts:
             raise ValueError("MoE decoder blocks are not supported here")
+        if self.rolling_cache and (
+            self.cfg.window is None or self.cfg.pos_style != "rope"
+        ):
+            raise ValueError(
+                "rolling_cache needs cfg.window (sliding-window "
+                "attention) and pos_style='rope' (positions are "
+                "unbounded, a learned table is not)"
+            )
 
     # -- params / cache ---------------------------------------------------
 
@@ -115,8 +132,11 @@ class GptDecoder:
         cfg = self.cfg
         dh = cfg.dim // cfg.num_heads
         # GQA caches store KV heads only — the architecture's memory
-        # win: cache bytes scale with kv_heads, not num_heads.
-        shape = (cfg.num_layers, batch, cfg.kv_heads, cfg.max_len, dh)
+        # win: cache bytes scale with kv_heads, not num_heads. Rolling
+        # caches bound the slot count by the attention window instead
+        # of max_len.
+        slots = cfg.window if self.rolling_cache else cfg.max_len
+        shape = (cfg.num_layers, batch, cfg.kv_heads, slots, dh)
         return {
             "k": jnp.zeros(shape, self.compute_dtype),
             "v": jnp.zeros(shape, self.compute_dtype),
@@ -177,48 +197,99 @@ class GptDecoder:
         q = self._split_heads(qf)
         k = self._split_heads(kf)
         v = self._split_heads(vf)
-        # Write the T new K/V rows at the cache head.
-        if per_slot:
-            upd = jax.vmap(
-                lambda c, new, pb: lax.dynamic_update_slice(
-                    c, new, (0, pb, 0)
-                )
-            )
-            k_cache = upd(k_cache, k, pos)
-            v_cache = upd(v_cache, v, pos)
-        else:
-            k_cache = lax.dynamic_update_slice(k_cache, k, (0, 0, pos, 0))
-            v_cache = lax.dynamic_update_slice(v_cache, v, (0, 0, pos, 0))
-
         b, h_q, t, _ = q.shape
-        hkv = k_cache.shape[1]
-        s_max = k_cache.shape[2]
+
+        if self.rolling_cache:
+            if per_slot:
+                raise NotImplementedError(
+                    "rolling caches are not wired into the per-slot "
+                    "decode server yet"
+                )
+            win = cfg.window
+            if t > win:
+                raise ValueError(
+                    f"a rolling-cache step takes at most window={win} "
+                    f"tokens at once (got {t}); prefill with chunk<={win}"
+                )
+            # New rows land at position % win (scatter; t <= win so
+            # slot indices are unique).
+            slots = (pos + jnp.arange(t)) % win
+            s_idx = jnp.arange(win)
+            if t == 1:
+                # Decode fast path: write first, attend the cache IN
+                # PLACE (no per-step concat copies of the whole
+                # window). After the write every slot holds the latest
+                # position <= pos congruent to it — always inside the
+                # window — so only never-written slots mask out.
+                k_cache = k_cache.at[:, :, slots, :].set(k)
+                v_cache = v_cache.at[:, :, slots, :].set(v)
+                k_att, v_att = k_cache, v_cache
+                held = pos - ((pos - s_idx) % win)  # (win,)
+                mask = (held >= 0)[None, :]  # (1, win)
+            else:
+                # Multi-token (prefill) step: attend over [cache,
+                # this step's keys] with EXPLICIT absolute positions —
+                # same-step rows never overwrite keys a same-step
+                # query still needs. Slot s holds the latest position
+                # <= pos-1 congruent to s (negative = never written).
+                held = pos - 1 - ((pos - 1 - s_idx) % win)  # (win,)
+                k_att = jnp.concatenate([k_cache, k], axis=2)
+                v_att = jnp.concatenate([v_cache, v], axis=2)
+                kpos = jnp.concatenate([held, pos + jnp.arange(t)])
+                qpos = pos + jnp.arange(t)[:, None]  # (T, 1)
+                mask = (
+                    (kpos[None, :] <= qpos)
+                    & (kpos[None, :] > qpos - win)
+                    & (kpos[None, :] >= 0)
+                )  # (T, win+T)
+                k_cache = k_cache.at[:, :, slots, :].set(k)
+                v_cache = v_cache.at[:, :, slots, :].set(v)
+        else:
+            # Write the T new K/V rows at the cache head.
+            if per_slot:
+                upd = jax.vmap(
+                    lambda c, new, pb: lax.dynamic_update_slice(
+                        c, new, (0, pb, 0)
+                    )
+                )
+                k_cache = upd(k_cache, k, pos)
+                v_cache = upd(v_cache, v, pos)
+            else:
+                k_cache = lax.dynamic_update_slice(
+                    k_cache, k, (0, 0, pos, 0)
+                )
+                v_cache = lax.dynamic_update_slice(
+                    v_cache, v, (0, 0, pos, 0)
+                )
+            k_att, v_att = k_cache, v_cache
+            # Causal-by-position: query t (absolute pos+t) sees cache
+            # slot j iff j <= pos + t; empty slots beyond the head are
+            # excluded by the same test. A sliding window additionally
+            # drops slots more than `window`-1 behind (Mistral-style).
+            j = jnp.arange(k_att.shape[2])
+            if per_slot:
+                tt = pos[:, None] + jnp.arange(t)  # (B, T)
+                mask = j[None, None, :] <= tt[:, :, None]  # (B, T, S)
+                if cfg.window is not None:
+                    mask &= j[None, None, :] > tt[:, :, None] - cfg.window
+                mask = mask[:, None, None, :, :]
+            else:
+                tt = pos + jnp.arange(t)[:, None]  # (T, 1)
+                mask = j[None, :] <= tt  # (T, S)
+                if cfg.window is not None:
+                    mask &= j[None, :] > tt - cfg.window
+
+        hkv = k_att.shape[1]
         qg = q.reshape(b, hkv, h_q // hkv, t, dh)
         logits = jnp.einsum(
             "bkgtd,bksd->bkgts",
             qg,
-            k_cache,
+            k_att,
             preferred_element_type=jnp.float32,
         ) * (dh**-0.5)
-        # Causal-by-position: query t (absolute pos+t) sees cache slot
-        # j iff j <= pos + t; empty slots beyond the head are excluded
-        # by the same test. A sliding window additionally drops slots
-        # more than `window`-1 behind the query (Mistral-style).
-        j = jnp.arange(s_max)
-        if per_slot:
-            tt = pos[:, None] + jnp.arange(t)  # (B, T)
-            mask = j[None, None, :] <= tt[:, :, None]  # (B, T, S)
-            if cfg.window is not None:
-                mask &= j[None, None, :] > tt[:, :, None] - cfg.window
-            mask = mask[:, None, None, :, :]
-        else:
-            tt = pos + jnp.arange(t)[:, None]  # (T, 1)
-            mask = j[None, :] <= tt  # (T, S)
-            if cfg.window is not None:
-                mask &= j[None, :] > tt - cfg.window
         logits = jnp.where(mask, logits, -jnp.inf)
         weights = jax.nn.softmax(logits, axis=-1).astype(dt)
-        attn = jnp.einsum("bkgts,bksd->bkgtd", weights, v_cache)
+        attn = jnp.einsum("bkgts,bksd->bkgtd", weights, v_att)
         attn = attn.reshape(b, h_q, t, dh)
         attn = attn.transpose(0, 2, 1, 3).reshape(b, t, h_q * dh)
         attn = attn @ W("wo")
@@ -387,7 +458,12 @@ class GptDecoder:
                 "caches admit through runtime/decode_server.py)"
             )
         base = int(jax.device_get(cache["pos"]))
-        if base + t0 > self.cfg.max_len:
+        if (
+            not self.rolling_cache
+            and base + t0 > self.cfg.max_len
+        ):
+            # Rolling caches have no end to overflow — positions are
+            # unbounded and slots recycle.
             raise ValueError(
                 f"cache position {base} + prompt {t0} exceeds max_len "
                 f"{self.cfg.max_len}"
@@ -407,7 +483,14 @@ class GptDecoder:
             # dynamic_update_slice CLAMPS an out-of-range start, which
             # would silently shift the write over earlier rows. At the
             # boundary, feed the short piece as its own compiled shape.
-            if real < chunk and base + start + chunk <= self.cfg.max_len:
+            # Rolling caches never pad: a pad row would EVICT the live
+            # slot at its position%W while the rewound mask still
+            # credits that slot with the evicted row's position.
+            if (
+                real < chunk
+                and not self.rolling_cache
+                and base + start + chunk <= self.cfg.max_len
+            ):
                 piece = jnp.concatenate(
                     [
                         piece,
@@ -439,7 +522,12 @@ class GptDecoder:
         compiled T=1 step with donated cache."""
         cfg = self.cfg
         b, t0 = prompt_ids.shape
-        if t0 + num_steps > cfg.max_len:
+        if self.rolling_cache:
+            # No length bound (slots recycle); long prompts stream
+            # through the cache one window at a time.
+            if prefill_chunk is None and t0 > cfg.window:
+                prefill_chunk = cfg.window
+        elif t0 + num_steps > cfg.max_len:
             raise ValueError(
                 f"prompt {t0} + steps {num_steps} exceeds max_len "
                 f"{cfg.max_len}"
@@ -468,10 +556,21 @@ class GptDecoder:
     def reference_logits(self, params: dict, ids: jax.Array) -> jax.Array:
         """Full causal forward (fresh cache, whole sequence in one
         non-donating step) — the correctness oracle for incremental
-        decoding."""
+        decoding. A rolling-cache decoder streams the sequence in
+        window-sized pieces instead (a single step is capped at the
+        window), collecting every position's logits."""
         cache = self.init_cache(ids.shape[0])
-        logits, _ = self.make_step(donate=False)(params, cache, ids)
-        return logits
+        step = self.make_step(donate=False)
+        if not self.rolling_cache or ids.shape[1] <= self.cfg.window:
+            logits, _ = step(params, cache, ids)
+            return logits
+        outs = []
+        for start in range(0, ids.shape[1], self.cfg.window):
+            logits, cache = step(
+                params, cache, ids[:, start : start + self.cfg.window]
+            )
+            outs.append(logits)
+        return jnp.concatenate(outs, axis=1)
 
 
 @dataclasses.dataclass

@@ -60,6 +60,14 @@ def speculative_generate(
     """
     if prompt_ids.shape[0] != 1:
         raise ValueError("speculative decoding is batch-1 (scalar rewind)")
+    for dec, name in ((target, "target"), (draft, "draft")):
+        if getattr(dec, "rolling_cache", False):
+            raise ValueError(
+                f"{name} uses a rolling cache: rejected tokens have "
+                "already overwritten live window slots, so a position "
+                "rewind cannot undo them — use flat windowed caches "
+                "for speculative decoding"
+            )
     if prompt_ids.shape[1] < 1:
         raise ValueError("prompt must have at least one token")
     if k < 1:

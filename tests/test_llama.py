@@ -285,3 +285,101 @@ def test_hf_mistral_parity():
         want = hf(torch.from_numpy(ids_np)).logits.numpy()
     got = np.asarray(dec.reference_logits(params, jnp.asarray(ids_np)))
     np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-4)
+
+
+def test_rolling_cache_matches_windowed_decoder():
+    """A rolling cache (window slots, scatter writes, explicit slot
+    positions) must reproduce the plain windowed decoder exactly —
+    incremental decode, generation, and a prompt longer than the
+    window (auto-chunked prefill)."""
+    W = 5
+    flat = _tiny_mistral(W)
+    roll = GptDecoder(
+        flat.cfg, compute_dtype=jnp.float32, rolling_cache=True
+    )
+    params = flat.init(jax.random.key(0))
+    cache = roll.init_cache(2)
+    assert cache["k"].shape[3] == W  # slots = window, not max_len
+
+    ids = jax.random.randint(jax.random.key(1), (2, 13), 0, 96)
+    want = flat.reference_logits(params, ids)
+    step = roll.make_step(donate=False)
+    c = roll.init_cache(2)
+    logits, c = step(params, c, ids[:, :4])
+    outs = [logits]
+    for t in range(4, 13):
+        logits, c = step(params, c, ids[:, t : t + 1])
+        outs.append(logits)
+    np.testing.assert_allclose(
+        np.asarray(jnp.concatenate(outs, axis=1)),
+        np.asarray(want),
+        rtol=2e-4,
+        atol=2e-5,
+    )
+
+    prompt = ids[:, :9]  # longer than W -> chunked rolling prefill
+    np.testing.assert_array_equal(
+        np.asarray(roll.generate(params, prompt, 6)),
+        np.asarray(flat.generate(params, prompt, 6)),
+    )
+
+
+def test_rolling_cache_generates_past_max_len():
+    """The point of the rolling cache: generation length is no longer
+    bounded by max_len (positions are unbounded, slots recycle)."""
+    W = 5
+    flat = _tiny_mistral(W)  # max_len 32
+    roll = GptDecoder(
+        flat.cfg, compute_dtype=jnp.float32, rolling_cache=True
+    )
+    params = flat.init(jax.random.key(0))
+    prompt = jnp.zeros((1, 3), jnp.int32)
+    out = roll.generate(params, prompt, 60)  # 63 > max_len 32
+    assert out.shape == (1, 63)
+    assert (np.asarray(out) >= 0).all()
+    # The first in-bounds stretch agrees with the flat decoder.
+    want = flat.generate(params, prompt, 20)
+    np.testing.assert_array_equal(
+        np.asarray(out[:, :23]), np.asarray(want)
+    )
+
+
+def test_rolling_cache_requires_window_and_rope():
+    from defer_tpu.models.gpt import tiny_gpt
+
+    with pytest.raises(ValueError, match="rolling_cache"):
+        GptDecoder(
+            tiny_gpt().cfg, compute_dtype=jnp.float32, rolling_cache=True
+        )
+
+
+def test_rolling_reference_logits_streams_long_sequences():
+    """The oracle itself works past the window for rolling decoders,
+    matching the flat windowed oracle position by position."""
+    W = 5
+    flat = _tiny_mistral(W)
+    roll = GptDecoder(
+        flat.cfg, compute_dtype=jnp.float32, rolling_cache=True
+    )
+    params = flat.init(jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (2, 17), 0, 96)
+    np.testing.assert_allclose(
+        np.asarray(roll.reference_logits(params, ids)),
+        np.asarray(flat.reference_logits(params, ids)),
+        rtol=2e-4,
+        atol=2e-5,
+    )
+
+
+def test_speculative_rejects_rolling_cache():
+    from defer_tpu.models.speculative import speculative_generate
+
+    W = 5
+    roll = GptDecoder(
+        _tiny_mistral(W).cfg, compute_dtype=jnp.float32, rolling_cache=True
+    )
+    params = roll.init(jax.random.key(0))
+    with pytest.raises(ValueError, match="rolling cache"):
+        speculative_generate(
+            roll, params, roll, params, jnp.zeros((1, 3), jnp.int32), 4
+        )
