@@ -67,6 +67,10 @@ class TransformerConfig:
     # counts — tokens over capacity are dropped, Switch-style).
     moe_dispatch: str = "dense"
     capacity_factor: float = 1.25
+    # Experts per token: 1 = Switch (output scaled by the raw top
+    # gate), >1 = Mixtral-style (weights renormalized over the
+    # selected experts).
+    moe_top_k: int = 1
     # -- llama-family knobs (defaults preserve the BERT/GPT behavior;
     #    defer_tpu/models/llama.py sets the full combination) --------
     # Grouped-query attention: K/V project to this many heads (each
@@ -101,6 +105,13 @@ class TransformerConfig:
                 f"capacity_factor={self.capacity_factor} must be > 0 "
                 "(non-positive values would silently drop almost every "
                 "token to the residual path)"
+            )
+        if self.num_experts and not (
+            1 <= self.moe_top_k <= self.num_experts
+        ):
+            raise ValueError(
+                f"moe_top_k={self.moe_top_k} must be in "
+                f"[1, num_experts={self.num_experts}]"
             )
         # Fail at construction, not as a KeyError deep inside jit
         # tracing (a typo'd knob would otherwise silently select the
@@ -255,12 +266,13 @@ def moe_ffn(
     *,
     tp_axis: str | None = None,
     ep_axis: str | None = None,
+    top_k: int = 1,
 ) -> jax.Array:
-    """Top-1 (switch-style) mixture-of-experts FFN on (B, S, D).
+    """Top-k mixture-of-experts FFN on (B, S, D) — dense dispatch.
 
     Expert parallelism by partition-of-experts: each device along
     ep_axis holds E_local experts, computes them for every token, and
-    the top-1 dispatch mask zeroes the rest before a psum over ep
+    the top-k dispatch mask zeroes the rest before a psum over ep
     combines shards. Dense dispatch keeps shapes static (no capacity /
     token dropping) — the XLA-friendly formulation; a capacity-based
     all_to_all dispatch is the scaling path for large expert counts.
@@ -270,13 +282,15 @@ def moe_ffn(
     """
     dt = x.dtype
     e_local = p["w1"].shape[0]
+    ep = 1 if ep_axis is None else lax.axis_size(ep_axis)
     ep_idx = 0 if ep_axis is None else lax.axis_index(ep_axis)
 
-    top, gate = _route_top1(p["router"], x)  # (B, S) each
-    global_ids = ep_idx * e_local + jnp.arange(e_local)
-    dispatch = (
-        (top[..., None] == global_ids) * gate[..., None]
-    ).astype(jnp.float32)  # (B, S, E_local)
+    idx, wts = _route_topk(p["router"], x, top_k)  # (B, S, k)
+    _, gate = _dispatch_weights(idx, wts, ep * e_local)  # (B, S, E)
+    # This device's expert columns of the global gate matrix.
+    dispatch = lax.dynamic_slice_in_dim(
+        gate, ep_idx * e_local, e_local, axis=-1
+    )  # (B, S, E_local)
 
     h = (
         jnp.einsum("bsd,edf->ebsf", x, p["w1"].astype(dt))
@@ -297,14 +311,27 @@ def moe_ffn(
     return out.astype(dt)
 
 
-def _route_top1(router: jax.Array, x: jax.Array):
-    """Shared top-1 routing (fp32 softmax over the GLOBAL expert
-    count): returns (expert_index, gate) over x's leading axes. ONE
+def _route_topk(router: jax.Array, x: jax.Array, k: int):
+    """Shared top-k routing (fp32 softmax over the GLOBAL expert
+    count): returns (expert_indices [..., k], weights [..., k]). ONE
     definition for both dispatches — dense/a2a equivalence depends on
-    the routing staying identical."""
+    the routing staying identical. k=1 keeps the Switch convention
+    (raw top probability as the gate); k>1 renormalizes over the
+    selected experts (Mixtral)."""
     logits = x.astype(jnp.float32) @ router.astype(jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1)
-    return probs.argmax(axis=-1), probs.max(axis=-1)
+    w, idx = jax.lax.top_k(probs, k)
+    if k > 1:
+        w = w / jnp.sum(w, axis=-1, keepdims=True)
+    return idx, w
+
+
+def _dispatch_weights(idx, w, e_global: int):
+    """(member [..., E] in {0,1}, gate [..., E]) from top-k routing."""
+    sel = jax.nn.one_hot(idx, e_global, dtype=jnp.float32)  # (..., k, E)
+    member = sel.sum(axis=-2)
+    gate = (sel * w[..., None]).sum(axis=-2)
+    return member, gate
 
 
 def moe_ffn_a2a(
@@ -314,8 +341,9 @@ def moe_ffn_a2a(
     capacity_factor: float = 1.25,
     tp_axis: str | None = None,
     ep_axis: str | None = None,
+    top_k: int = 1,
 ) -> jax.Array:
-    """Top-1 MoE FFN with all-to-all expert dispatch on (B, S, D).
+    """Top-k MoE FFN with all-to-all expert dispatch on (B, S, D).
 
     The scaling path dense dispatch can't reach: each device along ep
     takes ITS OWN 1/ep slice of the token stream (tokens arrive
@@ -331,7 +359,7 @@ def moe_ffn_a2a(
     N x E_local, and one psum reassembles the replicated output —
     the same closing collective as the dense dispatch.
 
-    Routing matches moe_ffn exactly (one shared _route_top1, per-token
+    Routing matches moe_ffn exactly (one shared _route_topk, per-token
     decisions), so with C large enough to drop nothing the two
     dispatches are numerically equivalent — that equivalence is the
     correctness test.
@@ -350,23 +378,25 @@ def moe_ffn_a2a(
             f"the expert axis size {ep}"
         )
     n_l = n // ep
-    cap = max(1, math.ceil(capacity_factor * n_l / e_global))
+    # Each token claims top_k slots, so capacity scales with k.
+    cap = max(1, math.ceil(capacity_factor * top_k * n_l / e_global))
 
     xf = x.reshape(n, d)
     ep_idx = 0 if ep_axis is None else lax.axis_index(ep_axis)
     x_own = lax.dynamic_slice_in_dim(xf, ep_idx * n_l, n_l)  # (n_l, D)
-    top, gate = _route_top1(p["router"], x_own)  # (n_l,) each
+    idx, wts = _route_topk(p["router"], x_own, top_k)  # (n_l, k)
+    member, gate = _dispatch_weights(idx, wts, e_global)  # (n_l, E)
 
-    onehot = jax.nn.one_hot(top, e_global, dtype=jnp.int32)  # (n_l, E)
-    # Arrival-order position of each token within its expert's queue;
-    # tokens at position >= cap are dropped (Switch-style).
-    pos_in_e = jnp.cumsum(onehot, axis=0) - 1  # (n_l, E)
-    keep = (pos_in_e < cap) & (onehot > 0)
+    # Arrival-order position of each token within each selected
+    # expert's queue; positions >= cap are dropped (Switch-style).
+    member_i = member.astype(jnp.int32)
+    pos_in_e = jnp.cumsum(member_i, axis=0) - 1  # (n_l, E)
+    keep = (pos_in_e < cap) & (member_i > 0)
     dispatch = (
         jax.nn.one_hot(pos_in_e, cap, dtype=jnp.float32)
         * keep[..., None]
     )  # (n_l, E, C)
-    combine = dispatch * gate[:, None, None].astype(jnp.float32)
+    combine = dispatch * gate[..., None].astype(jnp.float32)
 
     xin = jnp.einsum("nd,nec->ecd", x_own.astype(jnp.float32), dispatch)
     if ep_axis is not None:
@@ -560,9 +590,16 @@ def block_apply(
                 capacity_factor=cfg.capacity_factor,
                 tp_axis=tp_axis,
                 ep_axis=ep_axis,
+                top_k=cfg.moe_top_k,
             )
         else:
-            h = moe_ffn(p, f_in, tp_axis=tp_axis, ep_axis=ep_axis)
+            h = moe_ffn(
+                p,
+                f_in,
+                tp_axis=tp_axis,
+                ep_axis=ep_axis,
+                top_k=cfg.moe_top_k,
+            )
     elif cfg.ffn_style == "swiglu":
         # llama FFN: silu(gate) * up -> down (w1=gate, w3=up, w2=down).
         gate = jax.nn.silu(f_in @ p["w1"].astype(dt))

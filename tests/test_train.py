@@ -199,3 +199,62 @@ def test_remat_train_step_matches_exact(devices):
     # everything beyond that means wrong gradients.
     np.testing.assert_allclose(traces[0], traces[1], rtol=1e-6)
     assert traces[0][1] != traces[0][0]  # step 2 really used the grads
+
+
+def test_top2_moe_dense_equals_a2a(devices):
+    """Mixtral-style top-2 routing: the dense and a2a dispatches must
+    still agree exactly at no-drop capacity (each token now claims two
+    expert slots with renormalized weights)."""
+    import dataclasses
+
+    cfg = _cfg(
+        num_experts=4,
+        moe_top_k=2,
+        moe_dispatch="a2a",
+        capacity_factor=8.0,
+    )
+    mesh = make_mesh({"stage": 2, "expert": 4}, devices)
+    sb = SpmdBert(mesh, cfg, compute_dtype=jnp.float32)
+    params = sb.init(jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (3, 2, 8), 0, 64)
+    got = sb.make_step()(params, ids)
+
+    sb_dense = SpmdBert(
+        mesh,
+        dataclasses.replace(cfg, moe_dispatch="dense"),
+        compute_dtype=jnp.float32,
+    )
+    want = sb_dense.make_step()(params, ids)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-6
+    )
+
+    # Top-2 really engages a second expert: output differs from top-1
+    # on the same params.
+    sb_top1 = SpmdBert(
+        mesh,
+        dataclasses.replace(cfg, moe_top_k=1, moe_dispatch="dense"),
+        compute_dtype=jnp.float32,
+    )
+    top1 = sb_top1.make_step()(params, ids)
+    assert not np.allclose(np.asarray(want), np.asarray(top1))
+
+
+def test_top2_moe_trains(devices):
+    cfg = _cfg(num_experts=2, moe_top_k=2, moe_dispatch="a2a",
+               capacity_factor=4.0)
+    mesh = make_mesh({"stage": 2, "expert": 2, "data": 2}, devices)
+    sb = SpmdBert(mesh, cfg, compute_dtype=jnp.float32)
+    init_state, train_step = make_train_step(
+        sb, optax.adam(1e-3), num_classes=4
+    )
+    state = init_state(jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (3, 2, 8), 0, 64)
+    labels = jax.random.randint(jax.random.key(2), (3, 2), 0, 4)
+    _, loss = train_step(state, ids, labels)
+    assert jnp.isfinite(loss)
+
+
+def test_moe_top_k_validated():
+    with pytest.raises(ValueError, match="moe_top_k"):
+        _cfg(num_experts=2, moe_top_k=3)
