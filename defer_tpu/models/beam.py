@@ -1,0 +1,96 @@
+"""Beam-search decoding on the KV-cache decoder.
+
+Greedy decoding commits to the locally best token; beam search keeps
+the `beam_size` best partial sequences. TPU-shaped on the existing
+decoder: beams ARE the batch (one compiled (beam, 1) step), and a
+beam reorder is a GATHER along the cache's batch axis — static
+shapes, no host-side cache surgery. Scores are summed log-probs with
+an optional length penalty.
+
+Part of the beyond-reference serving surface (the reference streams
+CNN frames, src/test.py:30-41); composes with the same decoders as
+generate/speculative/continuous batching (flat or rolling caches,
+any family).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def beam_search(
+    dec: Any,
+    params: dict,
+    prompt_ids: jax.Array,
+    num_steps: int,
+    *,
+    beam_size: int = 4,
+    length_penalty: float = 0.0,
+) -> tuple[jax.Array, jax.Array]:
+    """Beam-search continuation of `prompt_ids` [1, T0].
+
+    Returns (ids [beam, T0 + num_steps], scores [beam]) sorted best
+    first; scores are sum log-prob / (length ** length_penalty).
+    beam_size=1 reduces exactly to greedy `generate`."""
+    if prompt_ids.shape[0] != 1:
+        raise ValueError("beam_search takes one prompt ([1, T0])")
+    if beam_size < 1:
+        raise ValueError(f"beam_size={beam_size} must be >= 1")
+    t0 = prompt_ids.shape[1]
+    if not getattr(dec, "rolling_cache", False) and (
+        t0 + num_steps > dec.cfg.max_len
+    ):
+        raise ValueError(
+            f"prompt {t0} + steps {num_steps} exceeds max_len "
+            f"{dec.cfg.max_len}"
+        )
+
+    B = beam_size
+    step = dec.make_step(donate=False)
+    # Prefill ONCE at batch 1 (prefill owns chunking for rolling
+    # caches and long prompts), then broadcast the cache lanes: the
+    # beams' prompt states are byte-identical, so computing them
+    # beam_size times would be pure waste.
+    small = dec.init_cache(1)
+    last, small = dec.prefill(params, small, prompt_ids)
+    cache = {
+        "k": jnp.repeat(small["k"], B, axis=1),
+        "v": jnp.repeat(small["v"], B, axis=1),
+        "pos": small["pos"],
+    }
+    ids = jnp.tile(prompt_ids, (B, 1))
+    logp = jax.nn.log_softmax(last.astype(jnp.float32), -1)  # (1, V)
+    # All beams start identical: only beam 0 may seed candidates, or
+    # the first expansion would pick the same token B times.
+    scores = jnp.where(jnp.arange(B) == 0, 0.0, -jnp.inf)
+
+    vocab = logp.shape[-1]
+    for i in range(num_steps):
+        total = scores[:, None] + logp  # (B, V) by broadcast
+        scores, flat = jax.lax.top_k(total.reshape(-1), B)
+        beam_idx = flat // vocab
+        token = (flat % vocab).astype(ids.dtype)
+        ids = jnp.concatenate(
+            [ids[beam_idx], token[:, None]], axis=1
+        )
+        if i + 1 == num_steps:
+            # The final tokens' successor logits are never used.
+            break
+        # Reorder beam lanes: gather along the cache batch axis.
+        cache = {
+            "k": cache["k"][:, beam_idx],
+            "v": cache["v"][:, beam_idx],
+            "pos": cache["pos"],
+        }
+        logits, cache = step(params, cache, token[:, None])
+        logp = jax.nn.log_softmax(
+            logits[:, -1, :].astype(jnp.float32), -1
+        )
+
+    if length_penalty:
+        scores = scores / (num_steps**length_penalty)
+    order = jnp.argsort(-scores)
+    return ids[order], scores[order]

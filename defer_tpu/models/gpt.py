@@ -458,12 +458,13 @@ class GptDecoder:
                 "caches admit through runtime/decode_server.py)"
             )
         base = int(jax.device_get(cache["pos"]))
-        if (
-            not self.rolling_cache
-            and base + t0 > self.cfg.max_len
-        ):
+        if self.rolling_cache:
             # Rolling caches have no end to overflow — positions are
-            # unbounded and slots recycle.
+            # unbounded and slots recycle — but a single step is
+            # capped at the window, so long prompts auto-chunk.
+            if chunk is None and t0 > self.cfg.window:
+                chunk = self.cfg.window
+        elif base + t0 > self.cfg.max_len:
             raise ValueError(
                 f"cache position {base} + prompt {t0} exceeds max_len "
                 f"{self.cfg.max_len}"
