@@ -34,7 +34,11 @@ def beam_search(
 
     Returns (ids [beam, T0 + num_steps], scores [beam]) sorted best
     first; scores are sum log-prob / (length ** length_penalty).
-    beam_size=1 reduces exactly to greedy `generate`."""
+    beam_size=1 reduces exactly to greedy `generate`.
+
+    With fixed-length decoding (no EOS; every beam generates exactly
+    num_steps tokens) length_penalty only RESCALES scores — it cannot
+    reorder beams until variable-length termination exists."""
     if prompt_ids.shape[0] != 1:
         raise ValueError("beam_search takes one prompt ([1, T0])")
     if beam_size < 1:

@@ -524,10 +524,9 @@ class GptDecoder:
         cfg = self.cfg
         b, t0 = prompt_ids.shape
         if self.rolling_cache:
-            # No length bound (slots recycle); long prompts stream
-            # through the cache one window at a time.
-            if prefill_chunk is None and t0 > cfg.window:
-                prefill_chunk = cfg.window
+            # No length bound (slots recycle); prefill itself
+            # auto-chunks long prompts at the window.
+            pass
         elif t0 + num_steps > cfg.max_len:
             raise ValueError(
                 f"prompt {t0} + steps {num_steps} exceeds max_len "

@@ -1,0 +1,67 @@
+"""Smoke the example drivers the way a user runs them: fresh
+interpreters, tiny configs, real argv — catches example bit-rot that
+library tests can't see."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENV = dict(
+    os.environ,
+    JAX_PLATFORMS="cpu",
+    XLA_FLAGS=(
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip(),
+)
+
+
+def _run(args, timeout=420):
+    proc = subprocess.run(
+        [sys.executable, *args],
+        cwd=REPO,
+        env=ENV,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    return proc.stdout
+
+
+def test_generate_example_llama_speculative():
+    out = _run(
+        [
+            "examples/generate.py", "--family", "llama", "--layers", "2",
+            "--dim", "64", "--heads", "4", "--kv-heads", "2",
+            "--ffn", "128", "--vocab", "96", "--max-len", "64",
+            "--prompt-len", "8", "--steps", "4", "--speculate", "2",
+        ]
+    )
+    assert "steady decode" in out and "speculative" in out
+
+
+def test_serve_decode_example_checked():
+    out = _run(
+        [
+            "examples/serve_decode.py", "--layers", "2", "--dim", "64",
+            "--heads", "4", "--ffn", "128", "--vocab", "96",
+            "--max-len", "128", "--requests", "4", "--slots", "2",
+            "--check",
+        ]
+    )
+    assert "outputs equal solo decodes" in out
+
+
+def test_pretrained_example_skips_cleanly_offline():
+    # No network, no cache, no --weights file: the documented SKIP
+    # contract (exit 0, SKIP line) must hold.
+    out = _run(
+        ["examples/pretrained_infer.py", "--weights", "/nonexistent.h5"]
+    )
+    assert "SKIP" in out
