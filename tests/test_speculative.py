@@ -111,3 +111,18 @@ def test_speculative_input_validation():
         speculative_generate(
             target, tp, draft, dp, jnp.zeros((1, 3), jnp.int32), 500
         )
+
+
+def test_speculative_with_int8_target():
+    """Speculative decoding composes with weight-only int8: quantized
+    target params still yield bit-exact agreement with the target's
+    own (quantized) greedy decode."""
+    from defer_tpu.models.quant import quantize_decoder_params
+
+    target, draft = _target(), _draft()
+    tp = quantize_decoder_params(target.init(jax.random.key(0)))
+    dp = draft.init(jax.random.key(1))
+    prompt = jnp.asarray([[5, 17, 3]], jnp.int32)
+    want = target.generate(tp, prompt, 10)
+    got, _ = speculative_generate(target, tp, draft, dp, prompt, 10, k=3)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
