@@ -122,13 +122,10 @@ def from_hf_state_dict(
     L = cfg.num_layers
     dh = cfg.dim // cfg.num_heads
 
+    from defer_tpu.models.transplant import tensor_to_numpy
+
     def t(name: str) -> np.ndarray:
-        w = state_dict[name]
-        try:  # torch tensor -> numpy
-            w = w.detach().cpu().numpy()
-        except AttributeError:
-            w = np.asarray(w)
-        return w
+        return tensor_to_numpy(state_dict[name])
 
     def proj(i: int, which: str) -> np.ndarray:
         return t(f"model.layers.{i}.self_attn.{which}.weight").T

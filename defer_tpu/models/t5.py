@@ -1,0 +1,701 @@
+"""T5 encoder-decoder family — beyond-reference model family.
+
+The reference's zoo is single-input CNNs (reference src/test.py:23);
+the framework's transformer families so far are encoder-only (BERT/ViT)
+and decoder-only (GPT/llama). T5 adds the third architecture class:
+a full encoder-decoder with cross-attention and T5's bucketed relative
+position bias, built TPU-first:
+
+  * both stacks keep the house layout — params stacked on a leading
+    [L] layer axis, applied with `lax.scan` (one compiled block body
+    per stack regardless of depth);
+  * the relative position bias lives in ONE [num_buckets, H] table per
+    stack (T5 computes it in block 0 and shares it; here it is a
+    top-level param), materialized once per forward as a [1, H, Tq, Tk]
+    additive bias — static shapes, MXU-friendly;
+  * incremental decoding uses the same static-buffer KV-cache design
+    as models/gpt.py (`lax.dynamic_update_slice`, masks by cache
+    position, one compiled T=1 step), plus per-layer cross-attention
+    K/V computed ONCE from the encoder output at cache start — the
+    encoder-decoder-specific win (cross K/V never change per step);
+  * T5 famously does NOT scale attention logits by 1/sqrt(dh) (the
+    scale is folded into initialization); full-sequence paths reuse
+    `ops.attention.multi_head_attention` by pre-scaling q by dh**0.5
+    to cancel its internal scaling, so checkpoints stay bit-faithful.
+
+Checkpoint interop follows the llama pattern (models/llama.py):
+`from_hf_state_dict` maps a HuggingFace `T5ForConditionalGeneration
+.state_dict()` onto the pytree, numerically validated against
+transformers' own forward in tests/test_t5.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from defer_tpu.models.gpt import sample_token
+from defer_tpu.ops.attention import multi_head_attention
+from defer_tpu.parallel.transformer_stack import _rms_norm
+
+
+@dataclasses.dataclass(frozen=True)
+class T5Config:
+    num_layers: int = 6  # encoder depth; decoder depth below
+    num_decoder_layers: int | None = None  # None = num_layers
+    dim: int = 512
+    num_heads: int = 8
+    head_dim: int = 64  # T5 decouples head_dim from dim/num_heads
+    ffn_dim: int = 2048
+    vocab_size: int = 32128
+    rel_buckets: int = 32
+    rel_max_distance: int = 128
+    layer_norm_eps: float = 1e-6
+    ffn_style: str = "relu"  # "relu" (v1.0) | "gated-gelu" (v1.1)
+    # v1.0 ties the LM head to the shared embedding (and scales the
+    # decoder output by dim**-0.5 before it); v1.1 ships a separate
+    # lm_head and does not scale.
+    tie_word_embeddings: bool = True
+    max_len: int = 512  # decoder KV-cache bound
+    decoder_start_token_id: int = 0  # T5 starts decoding from <pad>
+
+    @property
+    def inner_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def dec_layers(self) -> int:
+        return self.num_decoder_layers or self.num_layers
+
+    def __post_init__(self):
+        if self.ffn_style not in ("relu", "gated-gelu"):
+            raise ValueError(
+                f"ffn_style={self.ffn_style!r}: must be 'relu' or "
+                "'gated-gelu'"
+            )
+        if self.rel_buckets < 4 or self.rel_buckets % 2:
+            raise ValueError(
+                f"rel_buckets={self.rel_buckets} must be even and >= 4 "
+                "(bidirectional bucketing halves it)"
+            )
+        if self.rel_max_distance <= self.rel_buckets // 2:
+            # Causal bucketing's log range divides by
+            # log(max_distance / (num_buckets // 2)); a ratio <= 1
+            # makes that zero or negative and the bucket indices NaN.
+            raise ValueError(
+                f"rel_max_distance={self.rel_max_distance} must exceed "
+                f"rel_buckets // 2 = {self.rel_buckets // 2}"
+            )
+
+
+def relative_position_bucket(
+    rel: jax.Array,
+    *,
+    bidirectional: bool,
+    num_buckets: int,
+    max_distance: int,
+) -> jax.Array:
+    """T5's log-spaced relative-position bucketing.
+
+    `rel` = key_position - query_position (any integer shape). Half
+    the buckets cover exact small distances, the other half cover
+    log-spaced distances out to max_distance; bidirectional mode
+    splits the range again by sign. Matches HF transformers'
+    `T5Attention._relative_position_bucket` exactly (the transplant
+    test depends on it).
+    """
+    rel = rel.astype(jnp.int32)
+    n = num_buckets
+    ret = jnp.zeros_like(rel)
+    if bidirectional:
+        n //= 2
+        ret = ret + (rel > 0).astype(jnp.int32) * n
+        rel = jnp.abs(rel)
+    else:
+        rel = -jnp.minimum(rel, 0)
+    max_exact = n // 2
+    is_small = rel < max_exact
+    # Clamp before the log: rel=0 falls in the is_small branch, but a
+    # log(0) in the untaken branch would still poison int casting.
+    val_large = max_exact + (
+        jnp.log(jnp.maximum(rel, 1).astype(jnp.float32) / max_exact)
+        / math.log(max_distance / max_exact)
+        * (n - max_exact)
+    ).astype(jnp.int32)
+    val_large = jnp.minimum(val_large, n - 1)
+    return ret + jnp.where(is_small, rel, val_large)
+
+
+def _rel_bias(
+    table: jax.Array,
+    qpos: jax.Array,
+    kpos: jax.Array,
+    *,
+    bidirectional: bool,
+    num_buckets: int,
+    max_distance: int,
+) -> jax.Array:
+    """[1, H, Tq, Tk] additive attention bias from a [num_buckets, H]
+    table and absolute positions."""
+    rel = kpos[None, :] - qpos[:, None]  # (Tq, Tk)
+    buckets = relative_position_bucket(
+        rel,
+        bidirectional=bidirectional,
+        num_buckets=num_buckets,
+        max_distance=max_distance,
+    )
+    bias = jnp.take(table, buckets, axis=0)  # (Tq, Tk, H)
+    return bias.transpose(2, 0, 1)[None].astype(jnp.float32)
+
+
+@dataclasses.dataclass
+class T5:
+    """T5 encoder-decoder with KV-cached incremental decoding.
+
+    encode / decode_logits are the full-sequence paths (training &
+    the correctness oracle for the cached step); start_cache + step +
+    generate are the serving path.
+    """
+
+    cfg: T5Config
+    compute_dtype: Any = jnp.bfloat16
+
+    # -- params -----------------------------------------------------------
+
+    def init(self, rng: jax.Array) -> dict:
+        cfg = self.cfg
+        D, I, F = cfg.dim, cfg.inner_dim, cfg.ffn_dim
+        ks = iter(jax.random.split(rng, 24))
+
+        def stack(L: int, cross: bool) -> dict:
+            s = D**-0.5
+            p = {
+                "wq": jax.random.normal(next(ks), (L, D, I)) * s,
+                "wk": jax.random.normal(next(ks), (L, D, I)) * s,
+                "wv": jax.random.normal(next(ks), (L, D, I)) * s,
+                "wo": jax.random.normal(next(ks), (L, I, D)) * I**-0.5,
+                "ln1_scale": jnp.ones((L, D)),
+                "ln2_scale": jnp.ones((L, D)),
+                "w1": jax.random.normal(next(ks), (L, D, F)) * s,
+                "w2": jax.random.normal(next(ks), (L, F, D)) * F**-0.5,
+            }
+            if cfg.ffn_style == "gated-gelu":
+                p["w3"] = jax.random.normal(next(ks), (L, D, F)) * s
+            if cross:
+                p.update(
+                    {
+                        "cq": jax.random.normal(next(ks), (L, D, I)) * s,
+                        "ck": jax.random.normal(next(ks), (L, D, I)) * s,
+                        "cv": jax.random.normal(next(ks), (L, D, I)) * s,
+                        "co": jax.random.normal(next(ks), (L, I, D))
+                        * I**-0.5,
+                        "lnx_scale": jnp.ones((L, D)),
+                    }
+                )
+            return p
+
+        p = {
+            "token_embedding": jax.random.normal(
+                next(ks), (cfg.vocab_size, D)
+            ),
+            "enc_stack": stack(cfg.num_layers, cross=False),
+            "dec_stack": stack(cfg.dec_layers, cross=True),
+            "enc_rel_bias": jax.random.normal(
+                next(ks), (cfg.rel_buckets, cfg.num_heads)
+            )
+            * 0.1,
+            "dec_rel_bias": jax.random.normal(
+                next(ks), (cfg.rel_buckets, cfg.num_heads)
+            )
+            * 0.1,
+            "enc_final_ln": jnp.ones((D,)),
+            "dec_final_ln": jnp.ones((D,)),
+        }
+        if not cfg.tie_word_embeddings:
+            p["lm_head"] = (
+                jax.random.normal(next(ks), (cfg.vocab_size, D)) * D**-0.5
+            )
+        return p
+
+    def cast_params(self, params: dict) -> dict:
+        """Params re-stored in compute_dtype (serving configuration) —
+        same contract as GptDecoder.cast_params."""
+        return jax.tree_util.tree_map(
+            lambda a: a.astype(self.compute_dtype)
+            if jnp.issubdtype(a.dtype, jnp.floating)
+            else a,
+            params,
+        )
+
+    # -- shared pieces ----------------------------------------------------
+
+    def _ffn(self, p: dict, x: jax.Array) -> jax.Array:
+        dt = x.dtype
+        if self.cfg.ffn_style == "gated-gelu":
+            # T5 v1.1: gelu(wi_0) * wi_1 -> wo. HF's "gated-gelu" maps
+            # to gelu_new — the tanh approximation.
+            h = jax.nn.gelu(x @ p["w1"].astype(dt), approximate=True) * (
+                x @ p["w3"].astype(dt)
+            )
+        else:
+            h = jax.nn.relu(x @ p["w1"].astype(dt))
+        return h @ p["w2"].astype(dt)
+
+    def _rms(self, x: jax.Array, scale: jax.Array) -> jax.Array:
+        return _rms_norm(x, scale, self.cfg.layer_norm_eps)
+
+    def _attn_full(self, q, k, v, bias, *, causal: bool) -> jax.Array:
+        """Full-sequence attention through the shared op. T5 applies NO
+        1/sqrt(dh) scaling; pre-scaling q by dh**0.5 cancels the op's
+        internal scale exactly."""
+        return multi_head_attention(
+            q * self.cfg.head_dim**0.5,
+            k,
+            v,
+            num_heads=self.cfg.num_heads,
+            bias=bias,
+            causal=causal,
+            use_pallas=False,  # additive bias forces the XLA path anyway
+        )
+
+    # -- encoder ----------------------------------------------------------
+
+    def encode(self, params: dict, ids: jax.Array) -> jax.Array:
+        """[B, S] token ids -> [B, S, D] encoder output (final-LN'd)."""
+        cfg = self.cfg
+        cd = self.compute_dtype
+        x = jnp.take(params["token_embedding"], ids, axis=0).astype(cd)
+        pos = jnp.arange(ids.shape[1])
+        bias = _rel_bias(
+            params["enc_rel_bias"],
+            pos,
+            pos,
+            bidirectional=True,
+            num_buckets=cfg.rel_buckets,
+            max_distance=cfg.rel_max_distance,
+        )
+
+        def block(x, p):
+            dt = x.dtype
+            h = self._rms(x, p["ln1_scale"])
+            attn = self._attn_full(
+                h @ p["wq"].astype(dt),
+                h @ p["wk"].astype(dt),
+                h @ p["wv"].astype(dt),
+                bias,
+                causal=False,
+            )
+            x = x + attn @ p["wo"].astype(dt)
+            x = x + self._ffn(p, self._rms(x, p["ln2_scale"]))
+            return x, None
+
+        x, _ = lax.scan(block, x, params["enc_stack"])
+        return self._rms(x, params["enc_final_ln"])
+
+    # -- decoder (full sequence — training / oracle) ----------------------
+
+    def decode_logits(
+        self, params: dict, enc_out: jax.Array, dec_ids: jax.Array
+    ) -> jax.Array:
+        """Teacher-forced decoder: [B, Senc, D] x [B, Tdec] ->
+        [B, Tdec, V] fp32 logits."""
+        cfg = self.cfg
+        cd = self.compute_dtype
+        x = jnp.take(params["token_embedding"], dec_ids, axis=0).astype(cd)
+        enc_out = enc_out.astype(cd)
+        pos = jnp.arange(dec_ids.shape[1])
+        self_bias = _rel_bias(
+            params["dec_rel_bias"],
+            pos,
+            pos,
+            bidirectional=False,
+            num_buckets=cfg.rel_buckets,
+            max_distance=cfg.rel_max_distance,
+        )
+
+        def block(x, p):
+            dt = x.dtype
+            h = self._rms(x, p["ln1_scale"])
+            attn = self._attn_full(
+                h @ p["wq"].astype(dt),
+                h @ p["wk"].astype(dt),
+                h @ p["wv"].astype(dt),
+                self_bias,
+                causal=True,
+            )
+            x = x + attn @ p["wo"].astype(dt)
+            h = self._rms(x, p["lnx_scale"])
+            cross = self._attn_full(
+                h @ p["cq"].astype(dt),
+                enc_out @ p["ck"].astype(dt),
+                enc_out @ p["cv"].astype(dt),
+                None,
+                causal=False,
+            )
+            x = x + cross @ p["co"].astype(dt)
+            x = x + self._ffn(p, self._rms(x, p["ln2_scale"]))
+            return x, None
+
+        x, _ = lax.scan(block, x, params["dec_stack"])
+        x = self._rms(x, params["dec_final_ln"])
+        return self._head(params, x)
+
+    def _head(self, params: dict, x: jax.Array) -> jax.Array:
+        xf = x.astype(jnp.float32)
+        if self.cfg.tie_word_embeddings:
+            xf = xf * self.cfg.dim**-0.5
+        head = params.get("lm_head", params["token_embedding"])
+        return xf @ head.astype(jnp.float32).T
+
+    def forward(
+        self, params: dict, enc_ids: jax.Array, dec_ids: jax.Array
+    ) -> jax.Array:
+        """encode + teacher-forced decode in one call (the training
+        forward): [B, S] x [B, T] -> [B, T, V] logits."""
+        return self.decode_logits(params, self.encode(params, enc_ids), dec_ids)
+
+    # -- incremental decoding --------------------------------------------
+
+    def start_cache(self, params: dict, enc_out: jax.Array) -> dict:
+        """Serving cache for one encoded batch: empty self-attention
+        K/V buffers plus the cross-attention K/V of every decoder
+        layer, projected ONCE from the encoder output (they are
+        constant for the whole generation — the encoder-decoder-
+        specific saving; recomputing them per token would re-read
+        ck/cv and the encoder output every step)."""
+        cfg = self.cfg
+        cd = self.compute_dtype
+        b = enc_out.shape[0]
+        enc_out = enc_out.astype(cd)
+        H, dh = cfg.num_heads, cfg.head_dim
+        cross_k, cross_v = self._project_cross(params, enc_out)
+        return {
+            "k": jnp.zeros(
+                (cfg.dec_layers, b, H, cfg.max_len, dh), cd
+            ),
+            "v": jnp.zeros(
+                (cfg.dec_layers, b, H, cfg.max_len, dh), cd
+            ),
+            "cross_k": cross_k,
+            "cross_v": cross_v,
+            "pos": jnp.zeros((), jnp.int32),
+        }
+
+    def _project_cross(self, params: dict, enc_out: jax.Array):
+        """[L, B, H, Senc, Dh] cross K/V for all decoder layers (one
+        batched einsum per projection)."""
+        cfg = self.cfg
+        cd = enc_out.dtype
+        b, s_enc, _ = enc_out.shape
+        H, dh = cfg.num_heads, cfg.head_dim
+        ck = jnp.einsum(
+            "bsd,ldi->lbsi", enc_out, params["dec_stack"]["ck"].astype(cd)
+        )
+        cv = jnp.einsum(
+            "bsd,ldi->lbsi", enc_out, params["dec_stack"]["cv"].astype(cd)
+        )
+        shape = (cfg.dec_layers, b, s_enc, H, dh)
+        return (
+            ck.reshape(shape).transpose(0, 1, 3, 2, 4),
+            cv.reshape(shape).transpose(0, 1, 3, 2, 4),
+        )
+
+    def make_encode(self):
+        """Jitted (params, enc_ids) -> (enc_out, fresh serving cache):
+        the encoder scan and the per-layer cross-K/V projection compile
+        into ONE program (generate's eager path would otherwise pay
+        per-op dispatch for the whole encoder every call)."""
+        from defer_tpu.utils.memo import cached_step
+
+        def build():
+            def fn(params, ids):
+                enc_out = self.encode(params, ids)
+                return enc_out, self.start_cache(params, enc_out)
+
+            return jax.jit(fn)
+
+        return cached_step(self, "encode", build)
+
+    def prefill(
+        self, params: dict, cache: dict, ids: jax.Array
+    ) -> tuple[jax.Array, dict]:
+        """Consume [B, T] decoder ids into the cache; returns
+        (last_logits [B, V], cache). This is the GUARDED entry for
+        multi-token steps: the jitted step cannot check the write
+        head, and `lax.dynamic_update_slice` CLAMPS an out-of-range
+        start — an unguarded overflow would silently overwrite live
+        cache rows (same hazard gpt.py's prefill guards)."""
+        base = int(jax.device_get(cache["pos"]))
+        t = ids.shape[1]
+        if base + t > self.cfg.max_len:
+            raise ValueError(
+                f"cache position {base} + {t} tokens exceeds max_len "
+                f"{self.cfg.max_len}"
+            )
+        logits, cache = self.make_step()(params, cache, ids)
+        return logits[:, -1, :], cache
+
+    def make_step(self, *, donate: bool = True):
+        """Jitted (params, cache, ids [B, T]) -> (logits [B, T, V],
+        cache): the incremental decode step (prefill T>=1 or decode
+        T=1), static cache buffers, masks by cache position. The
+        caller must keep pos + T <= max_len (use `prefill` for the
+        guarded multi-token entry)."""
+        from defer_tpu.utils.memo import cached_step
+
+        cfg = self.cfg
+        cd = self.compute_dtype
+        H, dh = cfg.num_heads, cfg.head_dim
+
+        def step(params, cache, ids):
+            b, t = ids.shape
+            pos = cache["pos"]
+            x = jnp.take(params["token_embedding"], ids, axis=0).astype(cd)
+            qpos = pos + jnp.arange(t)
+            kpos = jnp.arange(cfg.max_len)
+            self_bias = _rel_bias(
+                params["dec_rel_bias"],
+                qpos,
+                kpos,
+                bidirectional=False,
+                num_buckets=cfg.rel_buckets,
+                max_distance=cfg.rel_max_distance,
+            )
+            # Causal-by-position over the static cache: query at
+            # absolute pos+i sees slot j iff j <= pos+i.
+            mask = kpos[None, :] <= qpos[:, None]  # (T, S_max)
+            self_bias = jnp.where(mask[None, None], self_bias, -jnp.inf)
+
+            def split(t_flat):
+                return t_flat.reshape(b, t, H, dh).transpose(0, 2, 1, 3)
+
+            def block(carry, layer):
+                x = carry
+                p, kc, vc, ck, cv = layer
+                dt = x.dtype
+                h = self._rms(x, p["ln1_scale"])
+                q = split(h @ p["wq"].astype(dt))
+                k = split(h @ p["wk"].astype(dt))
+                v = split(h @ p["wv"].astype(dt))
+                kc = lax.dynamic_update_slice(kc, k, (0, 0, pos, 0))
+                vc = lax.dynamic_update_slice(vc, v, (0, 0, pos, 0))
+                # T5: NO 1/sqrt(dh) scaling on the logits.
+                logits = jnp.einsum(
+                    "bhtd,bhsd->bhts",
+                    q,
+                    kc,
+                    preferred_element_type=jnp.float32,
+                )
+                logits = logits + self_bias
+                w = jax.nn.softmax(logits, axis=-1).astype(dt)
+                attn = jnp.einsum("bhts,bhsd->bhtd", w, vc)
+                attn = attn.transpose(0, 2, 1, 3).reshape(b, t, H * dh)
+                x = x + attn @ p["wo"].astype(dt)
+                # Cross-attention against the precomputed encoder K/V
+                # (no bias, no mask — every encoder position visible).
+                h = self._rms(x, p["lnx_scale"])
+                q = split(h @ p["cq"].astype(dt))
+                logits = jnp.einsum(
+                    "bhtd,bhsd->bhts",
+                    q,
+                    ck,
+                    preferred_element_type=jnp.float32,
+                )
+                w = jax.nn.softmax(logits, axis=-1).astype(dt)
+                cross = jnp.einsum("bhts,bhsd->bhtd", w, cv)
+                cross = cross.transpose(0, 2, 1, 3).reshape(b, t, H * dh)
+                x = x + cross @ p["co"].astype(dt)
+                x = x + self._ffn(p, self._rms(x, p["ln2_scale"]))
+                return x, (kc, vc)
+
+            x, (new_k, new_v) = lax.scan(
+                block,
+                x,
+                (
+                    params["dec_stack"],
+                    cache["k"],
+                    cache["v"],
+                    cache["cross_k"],
+                    cache["cross_v"],
+                ),
+            )
+            x = self._rms(x, params["dec_final_ln"])
+            new_cache = {
+                **cache,
+                "k": new_k,
+                "v": new_v,
+                "pos": pos + t,
+            }
+            return self._head(params, x), new_cache
+
+        return cached_step(
+            self,
+            donate,
+            lambda: jax.jit(step, donate_argnums=(1,) if donate else ()),
+        )
+
+    def generate(
+        self,
+        params: dict,
+        enc_ids: jax.Array,
+        num_steps: int,
+        *,
+        temperature: float = 0.0,
+        rng: jax.Array | None = None,
+    ) -> jax.Array:
+        """Encode once, then greedy/sampled decoding from the start
+        token: [B, Senc] -> [B, 1 + num_steps] decoder ids (leading
+        start token included)."""
+        cfg = self.cfg
+        if num_steps + 1 > cfg.max_len:
+            raise ValueError(
+                f"{num_steps} steps + start token exceeds max_len "
+                f"{cfg.max_len}"
+            )
+        b = enc_ids.shape[0]
+        _, cache = self.make_encode()(params, enc_ids)
+        step = self.make_step()
+        ids = jnp.full((b, 1), cfg.decoder_start_token_id, jnp.int32)
+        if rng is None:
+            rng = jax.random.key(0)
+        last, cache = self.prefill(params, cache, ids)
+        for i in range(num_steps):
+            nxt, rng = sample_token(last, rng, temperature)
+            nxt = nxt[:, None].astype(jnp.int32)
+            ids = jnp.concatenate([ids, nxt], axis=1)
+            if i + 1 < num_steps:
+                logits, cache = step(params, cache, nxt)
+                last = logits[:, -1, :]
+        return ids
+
+
+def t5_config(name: str = "small", **overrides: Any) -> T5Config:
+    """Named T5 shapes ("small", "base", "large") with overrides."""
+    shapes = {
+        "small": dict(num_layers=6, dim=512, num_heads=8, ffn_dim=2048),
+        "base": dict(num_layers=12, dim=768, num_heads=12, ffn_dim=3072),
+        "large": dict(
+            num_layers=24, dim=1024, num_heads=16, ffn_dim=4096
+        ),
+    }
+    if name not in shapes:
+        raise KeyError(f"unknown t5 size {name!r}; have {sorted(shapes)}")
+    kw: dict[str, Any] = dict(shapes[name])
+    kw.update(overrides)
+    return T5Config(**kw)
+
+
+def tiny_t5(**overrides: Any) -> T5:
+    """Small config for tests / CPU."""
+    kw: dict[str, Any] = dict(
+        num_layers=2,
+        dim=32,
+        num_heads=4,
+        head_dim=8,
+        ffn_dim=64,
+        vocab_size=96,
+        rel_buckets=8,
+        rel_max_distance=20,
+        max_len=32,
+    )
+    kw.update(overrides)
+    return T5(T5Config(**kw), compute_dtype=jnp.float32)
+
+
+def from_hf_state_dict(cfg: T5Config, state_dict: Mapping[str, Any]) -> dict:
+    """Map a HuggingFace `T5ForConditionalGeneration.state_dict()` onto
+    the T5 param pytree (torch Linear stores [out, in]; the stacks
+    compute x @ W with [in, out], so projections transpose)."""
+
+    from defer_tpu.models.transplant import tensor_to_numpy
+
+    def t(name: str) -> np.ndarray:
+        return tensor_to_numpy(state_dict[name])
+
+    def attn(side: str, i: int, layer: int, which: str) -> np.ndarray:
+        mod = "SelfAttention" if layer == 0 else "EncDecAttention"
+        return t(f"{side}.block.{i}.layer.{layer}.{mod}.{which}.weight").T
+
+    def ffn(side: str, i: int, layer: int, which: str) -> np.ndarray:
+        return t(
+            f"{side}.block.{i}.layer.{layer}.DenseReluDense.{which}.weight"
+        ).T
+
+    def ln(side: str, i: int, layer: int) -> np.ndarray:
+        return t(f"{side}.block.{i}.layer.{layer}.layer_norm.weight")
+
+    gated = cfg.ffn_style == "gated-gelu"
+    wi = "wi_0" if gated else "wi"
+
+    def stack(side: str, L: int, cross: bool) -> dict:
+        ffn_layer = 2 if cross else 1
+        p = {
+            "wq": np.stack([attn(side, i, 0, "q") for i in range(L)]),
+            "wk": np.stack([attn(side, i, 0, "k") for i in range(L)]),
+            "wv": np.stack([attn(side, i, 0, "v") for i in range(L)]),
+            "wo": np.stack([attn(side, i, 0, "o") for i in range(L)]),
+            "ln1_scale": np.stack([ln(side, i, 0) for i in range(L)]),
+            "ln2_scale": np.stack(
+                [ln(side, i, ffn_layer) for i in range(L)]
+            ),
+            "w1": np.stack([ffn(side, i, ffn_layer, wi) for i in range(L)]),
+            "w2": np.stack(
+                [ffn(side, i, ffn_layer, "wo") for i in range(L)]
+            ),
+        }
+        if gated:
+            p["w3"] = np.stack(
+                [ffn(side, i, ffn_layer, "wi_1") for i in range(L)]
+            )
+        if cross:
+            p.update(
+                {
+                    "cq": np.stack(
+                        [attn(side, i, 1, "q") for i in range(L)]
+                    ),
+                    "ck": np.stack(
+                        [attn(side, i, 1, "k") for i in range(L)]
+                    ),
+                    "cv": np.stack(
+                        [attn(side, i, 1, "v") for i in range(L)]
+                    ),
+                    "co": np.stack(
+                        [attn(side, i, 1, "o") for i in range(L)]
+                    ),
+                    "lnx_scale": np.stack(
+                        [ln(side, i, 1) for i in range(L)]
+                    ),
+                }
+            )
+        return {k: jnp.asarray(v) for k, v in p.items()}
+
+    params = {
+        "token_embedding": jnp.asarray(t("shared.weight")),
+        "enc_stack": stack("encoder", cfg.num_layers, cross=False),
+        "dec_stack": stack("decoder", cfg.dec_layers, cross=True),
+        "enc_rel_bias": jnp.asarray(
+            t(
+                "encoder.block.0.layer.0.SelfAttention"
+                ".relative_attention_bias.weight"
+            )
+        ),
+        "dec_rel_bias": jnp.asarray(
+            t(
+                "decoder.block.0.layer.0.SelfAttention"
+                ".relative_attention_bias.weight"
+            )
+        ),
+        "enc_final_ln": jnp.asarray(t("encoder.final_layer_norm.weight")),
+        "dec_final_ln": jnp.asarray(t("decoder.final_layer_norm.weight")),
+    }
+    if "lm_head.weight" in state_dict:
+        head = t("lm_head.weight")
+        if not np.array_equal(head, np.asarray(params["token_embedding"])):
+            params["lm_head"] = jnp.asarray(head)
+    return params

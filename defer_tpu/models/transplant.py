@@ -100,6 +100,20 @@ def _to_keras(op: str, param: str, value: np.ndarray, attrs) -> np.ndarray:
     return value
 
 
+def tensor_to_numpy(value: Any) -> np.ndarray:
+    """Coerce a checkpoint tensor (torch.Tensor — incl. bfloat16, which
+    `.numpy()` rejects — or anything array-like) to a numpy array,
+    without importing torch. The ONE coercion for every checkpoint-
+    interop path (CNN transplant, llama, t5)."""
+    if hasattr(value, "detach"):  # torch.Tensor
+        value = value.detach().cpu()
+        try:
+            value = value.numpy()
+        except TypeError:  # bfloat16: widen, then convert
+            value = value.float().numpy()
+    return np.asarray(value)
+
+
 def _from_torch(op: str, param: str, value: np.ndarray) -> np.ndarray:
     if param == "kernel":
         if op == "conv":
@@ -219,11 +233,9 @@ class TorchStateDict(WeightSource):
         key = f"{self.name_map(node.name)}.{keys[param]}"
         if key not in self.state_dict:
             return None
-        value = self.state_dict[key]
-        if hasattr(value, "detach"):  # torch.Tensor without importing torch
-            value = value.detach().cpu().numpy()
+        value = tensor_to_numpy(self.state_dict[key])
         self._used.add(key)
-        return _from_torch(node.op, param, np.asarray(value))
+        return _from_torch(node.op, param, value)
 
     def keys_used(self) -> set[str]:
         return self._used
