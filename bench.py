@@ -107,14 +107,25 @@ def _want_cpu() -> bool:
 
 
 def _is_init_error(err: str | None) -> bool:
-    """Did this attempt die before measuring anything, in backend init?
-    Those failures are process-local (a hung probe thread wedges only
-    its own process) — a fresh subprocess may reach the TPU."""
+    """Did this attempt die without a headline, for an environmental
+    reason a fresh subprocess might not hit? Backend-init failures are
+    process-local (a hung probe thread wedges only its own process),
+    and tunneled-TPU transport deaths (the remote-compile endpoint
+    refusing connections mid-run — observed when the axon tunnel
+    restarts) heal on the tunnel's side; both deserve the
+    TPU-reacquisition loop rather than an immediate CPU fallback."""
     if not err:
         return False
     return any(
         s in err
-        for s in ("BackendInitHang", "backend init", "requested platform")
+        for s in (
+            "BackendInitHang",
+            "backend init",
+            "requested platform",
+            "UNAVAILABLE",
+            "Connection refused",
+            "Connection Failed",
+        )
     )
 
 
@@ -1043,8 +1054,9 @@ def main() -> None:
             break
         pause = min(30.0, 5.0 * attempt)
         log(
-            f"supervisor: attempt {attempt} lost to backend init "
-            f"({err}); retrying in a fresh subprocess in {pause:.0f}s"
+            f"supervisor: attempt {attempt} lost to backend init / "
+            f"TPU transport ({err}); retrying in a fresh subprocess "
+            f"in {pause:.0f}s"
         )
         time.sleep(pause)
     if result is None:

@@ -36,6 +36,7 @@ from defer_tpu.parallel.transformer_stack import (
     _layer_norm,
     _rms_norm,
     apply_rope,
+    embed_lookup,
     init_stack,
     norm_apply,
 )
@@ -325,38 +326,7 @@ class GptDecoder:
 
             b, t = ids.shape
             pos = cache["pos"]
-            table = params["token_embedding"]
-            if tp_axis is None:
-                if isinstance(table, dict) and "q" in table:
-                    # int8 table: gather the int8 rows, widen just the
-                    # gathered [B, T, D] slice.
-                    emb = (
-                        jnp.take(table["q"], ids, axis=0).astype(
-                            jnp.float32
-                        )
-                        * table["s"]
-                    )
-                else:
-                    emb = jnp.take(table, ids, axis=0)
-            else:
-                # Vocab-row sharding: this shard owns rows
-                # [v0, v0 + V_local); out-of-range ids contribute
-                # zeros and the psum assembles full embeddings. An
-                # int8 table gathers its q rows and widens just the
-                # gathered slice.
-                quant = isinstance(table, dict) and "q" in table
-                rows = table["q"] if quant else table
-                v_local = rows.shape[0]
-                v0 = lax.axis_index(tp_axis) * v_local
-                local_ids = ids - v0
-                in_range = (local_ids >= 0) & (local_ids < v_local)
-                emb = jnp.take(
-                    rows, jnp.clip(local_ids, 0, v_local - 1), axis=0
-                )
-                if quant:
-                    emb = emb.astype(jnp.float32) * table["s"]
-                emb = jnp.where(in_range[..., None], emb, 0.0)
-                emb = lax.psum(emb, tp_axis)
+            emb = embed_lookup(params["token_embedding"], ids, tp_axis)
             if cfg.pos_style == "rope":
                 # Rotary positions enter inside each block's q/k.
                 x = emb.astype(cd)

@@ -42,7 +42,7 @@ from jax import lax
 
 from defer_tpu.models.gpt import sample_token
 from defer_tpu.ops.attention import multi_head_attention
-from defer_tpu.parallel.transformer_stack import _rms_norm
+from defer_tpu.parallel.transformer_stack import _rms_norm, embed_lookup
 
 
 @dataclasses.dataclass(frozen=True)
@@ -235,7 +235,9 @@ class T5:
 
     # -- shared pieces ----------------------------------------------------
 
-    def _ffn(self, p: dict, x: jax.Array) -> jax.Array:
+    def _ffn(
+        self, p: dict, x: jax.Array, tp_axis: str | None = None
+    ) -> jax.Array:
         dt = x.dtype
         if self.cfg.ffn_style == "gated-gelu":
             # T5 v1.1: gelu(wi_0) * wi_1 -> wo. HF's "gated-gelu" maps
@@ -245,7 +247,11 @@ class T5:
             )
         else:
             h = jax.nn.relu(x @ p["w1"].astype(dt))
-        return h @ p["w2"].astype(dt)
+        out = h @ p["w2"].astype(dt)
+        if tp_axis is not None:
+            # w1/w3 column-, w2 row-sharded: partial sums over tp.
+            out = lax.psum(out, tp_axis)
+        return out
 
     def _rms(self, x: jax.Array, scale: jax.Array) -> jax.Array:
         return _rms_norm(x, scale, self.cfg.layer_norm_eps)
@@ -253,24 +259,66 @@ class T5:
     def _attn_full(self, q, k, v, bias, *, causal: bool) -> jax.Array:
         """Full-sequence attention through the shared op. T5 applies NO
         1/sqrt(dh) scaling; pre-scaling q by dh**0.5 cancels the op's
-        internal scale exactly."""
+        internal scale exactly. Head count is inferred from the actual
+        projection width, so tensor-parallel shards (one head group
+        each) pass through unchanged."""
         return multi_head_attention(
             q * self.cfg.head_dim**0.5,
             k,
             v,
-            num_heads=self.cfg.num_heads,
+            num_heads=q.shape[-1] // self.cfg.head_dim,
             bias=bias,
             causal=causal,
             use_pallas=False,  # additive bias forces the XLA path anyway
         )
 
+    def _embed(
+        self, params: dict, ids: jax.Array, tp_axis: str | None
+    ) -> jax.Array:
+        """Token embedding in compute dtype (shared Megatron-sharded
+        gather, parallel/transformer_stack.embed_lookup)."""
+        return embed_lookup(
+            params["token_embedding"], ids, tp_axis
+        ).astype(self.compute_dtype)
+
     # -- encoder ----------------------------------------------------------
 
-    def encode(self, params: dict, ids: jax.Array) -> jax.Array:
-        """[B, S] token ids -> [B, S, D] encoder output (final-LN'd)."""
+    @staticmethod
+    def _key_mask_bias(mask: jax.Array | None) -> jax.Array | None:
+        """[B, S] validity mask (1 = real token) -> [B, 1, 1, S]
+        additive attention bias masking pad KEY positions. A large
+        finite constant, not -inf (HF's convention): an ALL-pad row
+        (zero-length input in a ragged batch) then softmaxes to
+        uniform garbage instead of NaN that would poison the whole
+        forward."""
+        if mask is None:
+            return None
+        return jnp.where(
+            mask.astype(bool)[:, None, None, :], 0.0, -1e9
+        )
+
+    def encode(
+        self,
+        params: dict,
+        ids: jax.Array,
+        tp_axis: str | None = None,
+        *,
+        mask: jax.Array | None = None,
+    ) -> jax.Array:
+        """[B, S] token ids -> [B, S, D] encoder output (final-LN'd).
+
+        `mask` [B, S] (1 = real token) excludes pad KEY positions from
+        every self-attention — required for batched variable-length
+        inputs padded to a common length (pad rows of the OUTPUT are
+        garbage; downstream cross-attention must mask them too, which
+        the decoder paths do when given the same mask).
+
+        With tp_axis set (inside shard_map), projections arrive
+        column-sharded as one head group per shard — the rel-bias
+        table's local width picks the matching head slice — and
+        wo/w2 row-sharded with psum (the Megatron pattern)."""
         cfg = self.cfg
-        cd = self.compute_dtype
-        x = jnp.take(params["token_embedding"], ids, axis=0).astype(cd)
+        x = self._embed(params, ids, tp_axis)
         pos = jnp.arange(ids.shape[1])
         bias = _rel_bias(
             params["enc_rel_bias"],
@@ -280,6 +328,9 @@ class T5:
             num_buckets=cfg.rel_buckets,
             max_distance=cfg.rel_max_distance,
         )
+        kb = self._key_mask_bias(mask)
+        if kb is not None:
+            bias = bias + kb
 
         def block(x, p):
             dt = x.dtype
@@ -291,8 +342,11 @@ class T5:
                 bias,
                 causal=False,
             )
-            x = x + attn @ p["wo"].astype(dt)
-            x = x + self._ffn(p, self._rms(x, p["ln2_scale"]))
+            attn = attn @ p["wo"].astype(dt)
+            if tp_axis is not None:
+                attn = lax.psum(attn, tp_axis)
+            x = x + attn
+            x = x + self._ffn(p, self._rms(x, p["ln2_scale"]), tp_axis)
             return x, None
 
         x, _ = lax.scan(block, x, params["enc_stack"])
@@ -301,14 +355,23 @@ class T5:
     # -- decoder (full sequence — training / oracle) ----------------------
 
     def decode_logits(
-        self, params: dict, enc_out: jax.Array, dec_ids: jax.Array
+        self,
+        params: dict,
+        enc_out: jax.Array,
+        dec_ids: jax.Array,
+        tp_axis: str | None = None,
+        *,
+        enc_mask: jax.Array | None = None,
     ) -> jax.Array:
         """Teacher-forced decoder: [B, Senc, D] x [B, Tdec] ->
-        [B, Tdec, V] fp32 logits."""
+        [B, Tdec, V] fp32 logits (the local vocab slice under tp).
+        `enc_mask` [B, Senc] excludes pad encoder positions from every
+        cross-attention (pass the mask given to encode)."""
         cfg = self.cfg
         cd = self.compute_dtype
-        x = jnp.take(params["token_embedding"], dec_ids, axis=0).astype(cd)
+        x = self._embed(params, dec_ids, tp_axis)
         enc_out = enc_out.astype(cd)
+        cross_bias = self._key_mask_bias(enc_mask)
         pos = jnp.arange(dec_ids.shape[1])
         self_bias = _rel_bias(
             params["dec_rel_bias"],
@@ -329,17 +392,23 @@ class T5:
                 self_bias,
                 causal=True,
             )
-            x = x + attn @ p["wo"].astype(dt)
+            attn = attn @ p["wo"].astype(dt)
+            if tp_axis is not None:
+                attn = lax.psum(attn, tp_axis)
+            x = x + attn
             h = self._rms(x, p["lnx_scale"])
             cross = self._attn_full(
                 h @ p["cq"].astype(dt),
                 enc_out @ p["ck"].astype(dt),
                 enc_out @ p["cv"].astype(dt),
-                None,
+                cross_bias,
                 causal=False,
             )
-            x = x + cross @ p["co"].astype(dt)
-            x = x + self._ffn(p, self._rms(x, p["ln2_scale"]))
+            cross = cross @ p["co"].astype(dt)
+            if tp_axis is not None:
+                cross = lax.psum(cross, tp_axis)
+            x = x + cross
+            x = x + self._ffn(p, self._rms(x, p["ln2_scale"]), tp_axis)
             return x, None
 
         x, _ = lax.scan(block, x, params["dec_stack"])
@@ -347,6 +416,9 @@ class T5:
         return self._head(params, x)
 
     def _head(self, params: dict, x: jax.Array) -> jax.Array:
+        """LM head. Under tp the head rows are the local vocab shard,
+        so this produces the local logits slice; the shard_map caller's
+        out_specs concatenate the slices into global logits."""
         xf = x.astype(jnp.float32)
         if self.cfg.tie_word_embeddings:
             xf = xf * self.cfg.dim**-0.5
@@ -354,26 +426,47 @@ class T5:
         return xf @ head.astype(jnp.float32).T
 
     def forward(
-        self, params: dict, enc_ids: jax.Array, dec_ids: jax.Array
+        self,
+        params: dict,
+        enc_ids: jax.Array,
+        dec_ids: jax.Array,
+        *,
+        enc_mask: jax.Array | None = None,
     ) -> jax.Array:
         """encode + teacher-forced decode in one call (the training
         forward): [B, S] x [B, T] -> [B, T, V] logits."""
-        return self.decode_logits(params, self.encode(params, enc_ids), dec_ids)
+        enc_out = self.encode(params, enc_ids, mask=enc_mask)
+        return self.decode_logits(
+            params, enc_out, dec_ids, enc_mask=enc_mask
+        )
 
     # -- incremental decoding --------------------------------------------
 
-    def start_cache(self, params: dict, enc_out: jax.Array) -> dict:
+    def start_cache(
+        self,
+        params: dict,
+        enc_out: jax.Array,
+        enc_mask: jax.Array | None = None,
+    ) -> dict:
         """Serving cache for one encoded batch: empty self-attention
         K/V buffers plus the cross-attention K/V of every decoder
         layer, projected ONCE from the encoder output (they are
         constant for the whole generation — the encoder-decoder-
         specific saving; recomputing them per token would re-read
-        ck/cv and the encoder output every step)."""
+        ck/cv and the encoder output every step). `enc_mask` [B, Senc]
+        bakes the pad-key exclusion into the cache as an additive
+        cross-attention bias."""
         cfg = self.cfg
         cd = self.compute_dtype
-        b = enc_out.shape[0]
+        b, s_enc, _ = enc_out.shape
         enc_out = enc_out.astype(cd)
-        H, dh = cfg.num_heads, cfg.head_dim
+        cross_bias = self._key_mask_bias(enc_mask)
+        if cross_bias is None:
+            cross_bias = jnp.zeros((b, 1, 1, s_enc), jnp.float32)
+        # Local head count from the actual projection width: under tp
+        # each shard caches only its own head group.
+        dh = cfg.head_dim
+        H = params["dec_stack"]["wk"].shape[-1] // dh
         cross_k, cross_v = self._project_cross(params, enc_out)
         return {
             "k": jnp.zeros(
@@ -384,16 +477,18 @@ class T5:
             ),
             "cross_k": cross_k,
             "cross_v": cross_v,
+            "cross_bias": cross_bias,
             "pos": jnp.zeros((), jnp.int32),
         }
 
     def _project_cross(self, params: dict, enc_out: jax.Array):
         """[L, B, H, Senc, Dh] cross K/V for all decoder layers (one
-        batched einsum per projection)."""
+        batched einsum per projection; H = local heads under tp)."""
         cfg = self.cfg
         cd = enc_out.dtype
         b, s_enc, _ = enc_out.shape
-        H, dh = cfg.num_heads, cfg.head_dim
+        dh = cfg.head_dim
+        H = params["dec_stack"]["ck"].shape[-1] // dh
         ck = jnp.einsum(
             "bsd,ldi->lbsi", enc_out, params["dec_stack"]["ck"].astype(cd)
         )
@@ -407,16 +502,19 @@ class T5:
         )
 
     def make_encode(self):
-        """Jitted (params, enc_ids) -> (enc_out, fresh serving cache):
-        the encoder scan and the per-layer cross-K/V projection compile
-        into ONE program (generate's eager path would otherwise pay
-        per-op dispatch for the whole encoder every call)."""
+        """Jitted (params, enc_ids, enc_mask) -> (enc_out, fresh
+        serving cache): the encoder scan and the per-layer cross-K/V
+        projection compile into ONE program (generate's eager path
+        would otherwise pay per-op dispatch for the whole encoder
+        every call). `enc_mask` is a concrete [B, Senc] validity mask
+        (all-ones when nothing is padded) so one compiled signature
+        serves both cases."""
         from defer_tpu.utils.memo import cached_step
 
         def build():
-            def fn(params, ids):
-                enc_out = self.encode(params, ids)
-                return enc_out, self.start_cache(params, enc_out)
+            def fn(params, ids, mask):
+                enc_out = self.encode(params, ids, mask=mask)
+                return enc_out, self.start_cache(params, enc_out, mask)
 
             return jax.jit(fn)
 
@@ -441,22 +539,20 @@ class T5:
         logits, cache = self.make_step()(params, cache, ids)
         return logits[:, -1, :], cache
 
-    def make_step(self, *, donate: bool = True):
-        """Jitted (params, cache, ids [B, T]) -> (logits [B, T, V],
-        cache): the incremental decode step (prefill T>=1 or decode
-        T=1), static cache buffers, masks by cache position. The
-        caller must keep pos + T <= max_len (use `prefill` for the
-        guarded multi-token entry)."""
-        from defer_tpu.utils.memo import cached_step
-
+    def _step_fn(self, tp_axis: str | None = None):
+        """The ONE incremental-step body shared by the single-device
+        and tensor-parallel paths (gpt.py's convention): under tp each
+        shard holds one head group (local-width splits, head-sliced
+        rel-bias table, head-group caches) and psums close the wo/co/w2
+        row-parallel matmuls; the embedding is vocab-row sharded."""
         cfg = self.cfg
-        cd = self.compute_dtype
-        H, dh = cfg.num_heads, cfg.head_dim
+        dh = cfg.head_dim
 
         def step(params, cache, ids):
             b, t = ids.shape
+            H = params["dec_stack"]["wk"].shape[-1] // dh
             pos = cache["pos"]
-            x = jnp.take(params["token_embedding"], ids, axis=0).astype(cd)
+            x = self._embed(params, ids, tp_axis)
             qpos = pos + jnp.arange(t)
             kpos = jnp.arange(cfg.max_len)
             self_bias = _rel_bias(
@@ -496,9 +592,13 @@ class T5:
                 w = jax.nn.softmax(logits, axis=-1).astype(dt)
                 attn = jnp.einsum("bhts,bhsd->bhtd", w, vc)
                 attn = attn.transpose(0, 2, 1, 3).reshape(b, t, H * dh)
-                x = x + attn @ p["wo"].astype(dt)
-                # Cross-attention against the precomputed encoder K/V
-                # (no bias, no mask — every encoder position visible).
+                attn = attn @ p["wo"].astype(dt)
+                if tp_axis is not None:
+                    attn = lax.psum(attn, tp_axis)
+                x = x + attn
+                # Cross-attention against the precomputed encoder K/V;
+                # cross_bias (baked at cache start) excludes pad
+                # encoder keys, all real positions stay visible.
                 h = self._rms(x, p["lnx_scale"])
                 q = split(h @ p["cq"].astype(dt))
                 logits = jnp.einsum(
@@ -507,11 +607,15 @@ class T5:
                     ck,
                     preferred_element_type=jnp.float32,
                 )
+                logits = logits + cache["cross_bias"]
                 w = jax.nn.softmax(logits, axis=-1).astype(dt)
                 cross = jnp.einsum("bhts,bhsd->bhtd", w, cv)
                 cross = cross.transpose(0, 2, 1, 3).reshape(b, t, H * dh)
-                x = x + cross @ p["co"].astype(dt)
-                x = x + self._ffn(p, self._rms(x, p["ln2_scale"]))
+                cross = cross @ p["co"].astype(dt)
+                if tp_axis is not None:
+                    cross = lax.psum(cross, tp_axis)
+                x = x + cross
+                x = x + self._ffn(p, self._rms(x, p["ln2_scale"]), tp_axis)
                 return x, (kc, vc)
 
             x, (new_k, new_v) = lax.scan(
@@ -534,10 +638,22 @@ class T5:
             }
             return self._head(params, x), new_cache
 
+        return step
+
+    def make_step(self, *, donate: bool = True):
+        """Jitted (params, cache, ids [B, T]) -> (logits [B, T, V],
+        cache): the incremental decode step (prefill T>=1 or decode
+        T=1), static cache buffers, masks by cache position. The
+        caller must keep pos + T <= max_len (use `prefill` for the
+        guarded multi-token entry)."""
+        from defer_tpu.utils.memo import cached_step
+
         return cached_step(
             self,
-            donate,
-            lambda: jax.jit(step, donate_argnums=(1,) if donate else ()),
+            ("step", donate),
+            lambda: jax.jit(
+                self._step_fn(), donate_argnums=(1,) if donate else ()
+            ),
         )
 
     def generate(
@@ -548,10 +664,12 @@ class T5:
         *,
         temperature: float = 0.0,
         rng: jax.Array | None = None,
+        enc_mask: jax.Array | None = None,
     ) -> jax.Array:
         """Encode once, then greedy/sampled decoding from the start
         token: [B, Senc] -> [B, 1 + num_steps] decoder ids (leading
-        start token included)."""
+        start token included). Pass `enc_mask` [B, Senc] (1 = real
+        token) when the batch was padded to a common length."""
         cfg = self.cfg
         if num_steps + 1 > cfg.max_len:
             raise ValueError(
@@ -559,7 +677,9 @@ class T5:
                 f"{cfg.max_len}"
             )
         b = enc_ids.shape[0]
-        _, cache = self.make_encode()(params, enc_ids)
+        if enc_mask is None:
+            enc_mask = jnp.ones(enc_ids.shape, jnp.int32)
+        _, cache = self.make_encode()(params, enc_ids, enc_mask)
         step = self.make_step()
         ids = jnp.full((b, 1), cfg.decoder_start_token_id, jnp.int32)
         if rng is None:
@@ -573,6 +693,221 @@ class T5:
                 logits, cache = step(params, cache, nxt)
                 last = logits[:, -1, :]
         return ids
+
+
+@dataclasses.dataclass
+class SpmdT5(T5):
+    """Tensor-parallel T5 over a 'model' mesh axis: one head group per
+    shard in BOTH stacks (self- and cross-attention caches hold local
+    heads only, the rel-bias tables shard on their head axis so each
+    group reads just its own biases), column/row-sharded FFNs with
+    psum, and a Megatron vocab-row-sharded embedding / LM head (padded
+    to a tp multiple) — every weight matrix read 1/tp per chip, the
+    same contract as SpmdGptDecoder."""
+
+    mesh: Any = None
+    tp_axis: str = "model"
+
+    def __post_init__(self):
+        if self.mesh is None or self.tp_axis not in self.mesh.axis_names:
+            raise ValueError(
+                f"SpmdT5 needs a mesh with a {self.tp_axis!r} axis"
+            )
+        cfg = self.cfg
+        tp = self.mesh.shape[self.tp_axis]
+        if cfg.num_heads % tp or cfg.ffn_dim % tp:
+            raise ValueError(
+                f"num_heads={cfg.num_heads} and ffn_dim={cfg.ffn_dim} "
+                f"must divide by tp={tp}"
+            )
+        self._vocab_padded = -(-cfg.vocab_size // tp) * tp
+
+    def _specs(self) -> dict:
+        from jax.sharding import PartitionSpec as P
+
+        tp = self.tp_axis
+        gated = self.cfg.ffn_style == "gated-gelu"
+
+        def stack(cross: bool) -> dict:
+            p = {
+                "wq": P(None, None, tp),
+                "wk": P(None, None, tp),
+                "wv": P(None, None, tp),
+                "wo": P(None, tp, None),
+                "ln1_scale": P(None, None),
+                "ln2_scale": P(None, None),
+                "w1": P(None, None, tp),
+                "w2": P(None, tp, None),
+            }
+            if gated:
+                p["w3"] = P(None, None, tp)
+            if cross:
+                p.update(
+                    {
+                        "cq": P(None, None, tp),
+                        "ck": P(None, None, tp),
+                        "cv": P(None, None, tp),
+                        "co": P(None, tp, None),
+                        "lnx_scale": P(None, None),
+                    }
+                )
+            return p
+
+        specs = {
+            "token_embedding": P(tp, None),
+            "enc_stack": stack(False),
+            "dec_stack": stack(True),
+            # Head axis sharded: each group reads only its own biases.
+            "enc_rel_bias": P(None, tp),
+            "dec_rel_bias": P(None, tp),
+            "enc_final_ln": P(None),
+            "dec_final_ln": P(None),
+        }
+        if not self.cfg.tie_word_embeddings:
+            specs["lm_head"] = P(tp, None)
+        return specs
+
+    def shard_params(self, params: dict) -> dict:
+        """Place replicated-init params onto the mesh (vocab rows
+        padded to a tp multiple; pad rows are zeros, masked out of
+        lookups and sliced off the logits)."""
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        pad = self._vocab_padded - params["token_embedding"].shape[0]
+        if pad:
+            params = {
+                **params,
+                "token_embedding": jnp.pad(
+                    params["token_embedding"], ((0, pad), (0, 0))
+                ),
+            }
+            if "lm_head" in params:
+                params["lm_head"] = jnp.pad(
+                    params["lm_head"], ((0, pad), (0, 0))
+                )
+        return jax.device_put(
+            params,
+            jax.tree_util.tree_map(
+                lambda s: NamedSharding(self.mesh, s),
+                self._specs(),
+                is_leaf=lambda s: isinstance(s, P),
+            ),
+        )
+
+    def _cache_spec(self) -> dict:
+        from jax.sharding import PartitionSpec as P
+
+        tp = self.tp_axis
+        kv = P(None, None, tp, None, None)  # [L, B, H, S, Dh] heads/tp
+        return {
+            "k": kv,
+            "v": kv,
+            "cross_k": kv,
+            "cross_v": kv,
+            "cross_bias": P(None, None, None, None),  # replicated
+            "pos": P(),
+        }
+
+    def make_encode(self):
+        from defer_tpu.utils.memo import cached_step
+        from jax.sharding import PartitionSpec as P
+
+        def build():
+            def fn(params, ids, mask):
+                enc_out = self.encode(
+                    params, ids, tp_axis=self.tp_axis, mask=mask
+                )
+                return enc_out, self.start_cache(params, enc_out, mask)
+
+            return jax.jit(
+                jax.shard_map(
+                    fn,
+                    mesh=self.mesh,
+                    in_specs=(self._specs(), P(None, None), P(None, None)),
+                    out_specs=(P(None, None, None), self._cache_spec()),
+                )
+            )
+
+        return cached_step(self, "encode", build)
+
+    def make_forward(self):
+        """Jitted tensor-parallel teacher-forced forward:
+        (params, enc_ids, dec_ids, enc_mask) -> [B, T, V] fp32 logits
+        — the tp training/eval path (encode + decode_logits under one
+        shard_map; the vocab-sharded logit slices concatenate on the
+        way out and the pad rows are sliced off)."""
+        from defer_tpu.utils.memo import cached_step
+        from jax.sharding import PartitionSpec as P
+
+        vocab = self.cfg.vocab_size
+
+        def build():
+            def fn(params, enc_ids, dec_ids, mask):
+                enc_out = self.encode(
+                    params, enc_ids, tp_axis=self.tp_axis, mask=mask
+                )
+                return self.decode_logits(
+                    params,
+                    enc_out,
+                    dec_ids,
+                    tp_axis=self.tp_axis,
+                    enc_mask=mask,
+                )
+
+            smapped = jax.shard_map(
+                fn,
+                mesh=self.mesh,
+                in_specs=(
+                    self._specs(),
+                    P(None, None),
+                    P(None, None),
+                    P(None, None),
+                ),
+                out_specs=P(None, None, self.tp_axis),
+            )
+
+            def forward(params, enc_ids, dec_ids, mask):
+                return smapped(params, enc_ids, dec_ids, mask)[..., :vocab]
+
+            return jax.jit(forward)
+
+        return cached_step(self, "forward", build)
+
+    def make_step(self, *, donate: bool = True):
+        from defer_tpu.utils.memo import cached_step
+        from jax.sharding import PartitionSpec as P
+
+        vocab = self.cfg.vocab_size
+
+        def build():
+            smapped = jax.shard_map(
+                self._step_fn(tp_axis=self.tp_axis),
+                mesh=self.mesh,
+                in_specs=(self._specs(), self._cache_spec(), P(None, None)),
+                # Vocab-sharded logit slices concatenate on the way out.
+                out_specs=(P(None, None, self.tp_axis), self._cache_spec()),
+            )
+
+            def step(params, cache, ids):
+                logits, cache = smapped(params, cache, ids)
+                # Drop the pad vocab rows (zeros — could win an argmax).
+                return logits[..., :vocab], cache
+
+            return jax.jit(step, donate_argnums=(1,) if donate else ())
+
+        return cached_step(self, ("step", donate), build)
+
+
+def spmd_t5(
+    mesh: Any,
+    cfg: T5Config,
+    *,
+    compute_dtype: Any = jnp.bfloat16,
+    tp_axis: str = "model",
+) -> SpmdT5:
+    """Tensor-parallel T5 serving (mirrors models/llama.spmd_llama)."""
+    return SpmdT5(cfg, compute_dtype=compute_dtype, mesh=mesh, tp_axis=tp_axis)
 
 
 def t5_config(name: str = "small", **overrides: Any) -> T5Config:
@@ -698,4 +1033,19 @@ def from_hf_state_dict(cfg: T5Config, state_dict: Mapping[str, Any]) -> dict:
         head = t("lm_head.weight")
         if not np.array_equal(head, np.asarray(params["token_embedding"])):
             params["lm_head"] = jnp.asarray(head)
+    # Tie mismatches are the one config error that would otherwise fail
+    # SILENTLY: _head both picks the weight and applies the tied-only
+    # dim**-0.5 scaling from cfg, so a checkpoint that disagrees with
+    # cfg.tie_word_embeddings yields logits off by sqrt(dim).
+    if cfg.tie_word_embeddings and "lm_head" in params:
+        raise ValueError(
+            "checkpoint carries a distinct lm_head but "
+            "cfg.tie_word_embeddings=True — load it with a v1.1-style "
+            "config (tie_word_embeddings=False)"
+        )
+    if not cfg.tie_word_embeddings and "lm_head" not in params:
+        raise ValueError(
+            "cfg.tie_word_embeddings=False but the checkpoint has no "
+            "distinct lm_head — load it with tie_word_embeddings=True"
+        )
     return params

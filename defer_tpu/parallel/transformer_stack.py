@@ -437,6 +437,36 @@ def moe_ffn_a2a(
     return out.astype(dt).reshape(b, s, d)
 
 
+def embed_lookup(
+    table: Any, ids: jax.Array, tp_axis: str | None = None
+) -> jax.Array:
+    """Token-embedding gather shared by every decoder family (gpt,
+    llama, t5).
+
+    Plain [V, D] tables gather directly; int8 weight-only tables
+    ({"q", "s"}, models/quant.py) gather the int8 rows and widen just
+    the gathered [B, T, D] slice. With tp_axis set (inside shard_map)
+    the table is vocab-ROW sharded (Megatron): this shard owns rows
+    [v0, v0 + V_local), out-of-range ids contribute zeros, and one
+    psum assembles full embeddings."""
+    quant = isinstance(table, dict) and "q" in table
+    rows = table["q"] if quant else table
+    if tp_axis is None:
+        emb = jnp.take(rows, ids, axis=0)
+        if quant:
+            emb = emb.astype(jnp.float32) * table["s"]
+        return emb
+    v_local = rows.shape[0]
+    v0 = lax.axis_index(tp_axis) * v_local
+    local_ids = ids - v0
+    in_range = (local_ids >= 0) & (local_ids < v_local)
+    emb = jnp.take(rows, jnp.clip(local_ids, 0, v_local - 1), axis=0)
+    if quant:
+        emb = emb.astype(jnp.float32) * table["s"]
+    emb = jnp.where(in_range[..., None], emb, 0.0)
+    return lax.psum(emb, tp_axis)
+
+
 def _layer_norm(x, scale, bias, eps):
     xf = x.astype(jnp.float32)
     mean = jnp.mean(xf, axis=-1, keepdims=True)

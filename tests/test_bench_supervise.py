@@ -111,3 +111,20 @@ def test_read_snapshot_skips_torn_tail(tmp_path):
     p.write_text('{"value": 1}\n{"value": 2}\n{"val')  # torn final write
     assert bench.read_snapshot(str(p)) == {"value": 2}
     assert bench.read_snapshot(str(tmp_path / "missing.jsonl")) is None
+
+
+def test_is_init_error_classification():
+    """The TPU-reacquisition loop must retry on backend-init failures
+    AND on tunneled-transport deaths (remote-compile endpoint refusing
+    connections mid-run), but never on ordinary measurement bugs."""
+    assert bench._is_init_error("BackendInitHang: devices() exceeded 180s")
+    assert bench._is_init_error(
+        "JaxRuntimeError: UNAVAILABLE: http://127.0.0.1:8083/"
+        "remote_compile: Connection Failed: Connection refused (os error 111)"
+    )
+    assert bench._is_init_error(
+        "RuntimeError: requested platform 'tpu' but got CPU devices"
+    )
+    assert not bench._is_init_error("ValueError: no batch size measured")
+    assert not bench._is_init_error(None)
+    assert not bench._is_init_error("")

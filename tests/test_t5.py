@@ -169,6 +169,130 @@ def test_cross_kv_precomputed_once():
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+def test_tp_generate_matches_single_device(devices):
+    """tp=2 sharded T5 (head-group-sharded caches + head-sliced rel
+    bias + vocab-sharded embedding/head) produces the single-device
+    tokens; vocab 97 exercises the pad-to-tp path."""
+    from defer_tpu.models.t5 import spmd_t5
+    from defer_tpu.parallel.mesh import make_mesh
+
+    single = tiny_t5(vocab_size=97)
+    params = single.init(jax.random.key(0))
+    enc_ids = jax.random.randint(jax.random.key(1), (2, 6), 0, 97)
+    want = single.generate(params, enc_ids, 5)
+
+    mesh = make_mesh({"model": 2}, devices[:2])
+    tp = spmd_t5(mesh, single.cfg, compute_dtype=jnp.float32)
+    got = tp.generate(tp.shard_params(params), enc_ids, 5)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_tp_logits_match_single_device(devices):
+    """tp=4 sharded incremental step reproduces single-device logits
+    (not just argmax tokens) for the v1.1 gated/untied shape."""
+    from defer_tpu.models.t5 import spmd_t5
+    from defer_tpu.parallel.mesh import make_mesh
+
+    single = tiny_t5(ffn_style="gated-gelu", tie_word_embeddings=False)
+    params = single.init(jax.random.key(0))
+    enc_ids = jax.random.randint(jax.random.key(1), (1, 5), 0, 96)
+    dec_ids = jax.random.randint(jax.random.key(2), (1, 4), 0, 96)
+
+    enc_out = single.encode(params, enc_ids)
+    cache = single.start_cache(params, enc_out)
+    want, _ = single.make_step(donate=False)(params, cache, dec_ids)
+
+    mesh = make_mesh({"model": 4}, devices[:4])
+    tp = spmd_t5(mesh, single.cfg, compute_dtype=jnp.float32)
+    sp = tp.shard_params(params)
+    ones = jnp.ones(enc_ids.shape, jnp.int32)
+    _, tcache = tp.make_encode()(sp, enc_ids, ones)
+    got, _ = tp.make_step(donate=False)(sp, tcache, dec_ids)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5
+    )
+
+
+def test_tp_teacher_forced_forward_matches(devices):
+    """SpmdT5.make_forward (the tp training/eval path) reproduces the
+    single-device teacher-forced logits, masked ragged batch included."""
+    from defer_tpu.models.t5 import spmd_t5
+    from defer_tpu.parallel.mesh import make_mesh
+
+    single = tiny_t5(vocab_size=97)
+    params = single.init(jax.random.key(0))
+    enc_ids = jax.random.randint(jax.random.key(1), (2, 6), 1, 97)
+    dec_ids = jax.random.randint(jax.random.key(2), (2, 4), 0, 97)
+    mask = jnp.asarray([[1, 1, 1, 1, 0, 0], [1, 1, 1, 1, 1, 1]], jnp.int32)
+    want = single.forward(params, enc_ids, dec_ids, enc_mask=mask)
+
+    mesh = make_mesh({"model": 2}, devices[:2])
+    tp = spmd_t5(mesh, single.cfg, compute_dtype=jnp.float32)
+    got = tp.make_forward()(tp.shard_params(params), enc_ids, dec_ids, mask)
+    assert got.shape == (2, 4, 97)  # pad vocab rows sliced off
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5
+    )
+
+
+def test_all_pad_row_stays_finite():
+    """A zero-length input (all-pad mask row) must not poison the
+    batch with NaN — the finite mask constant keeps its logits
+    garbage-but-finite and other rows exact."""
+    m = tiny_t5()
+    params = m.init(jax.random.key(0))
+    enc_ids = jax.random.randint(jax.random.key(1), (2, 5), 1, 96)
+    dec = jnp.zeros((2, 3), jnp.int32)
+    mask = jnp.asarray([[0, 0, 0, 0, 0], [1, 1, 1, 0, 0]], jnp.int32)
+    logits = m.forward(params, enc_ids, dec, enc_mask=mask)
+    assert bool(jnp.isfinite(logits).all())
+    # The healthy row is unaffected by its all-pad neighbour.
+    want = m.forward(
+        params, enc_ids[1:], dec[1:], enc_mask=mask[1:]
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits[1:]), np.asarray(want), rtol=2e-4, atol=2e-5
+    )
+
+
+def test_enc_mask_matches_unpadded_run():
+    """A padded batch with enc_mask must generate the same tokens as
+    the unpadded sequence — pad keys excluded from encoder self-
+    attention and from every cached cross-attention step."""
+    m = tiny_t5()
+    params = m.init(jax.random.key(0))
+    real = jax.random.randint(jax.random.key(1), (1, 5), 1, 96)
+    want = m.generate(params, real, 6)
+
+    padded = jnp.concatenate(
+        [real, jnp.zeros((1, 4), real.dtype)], axis=1
+    )
+    mask = jnp.concatenate(
+        [jnp.ones((1, 5), jnp.int32), jnp.zeros((1, 4), jnp.int32)], axis=1
+    )
+    got = m.generate(params, padded, 6, enc_mask=mask)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # ... and the mask genuinely matters: without it the pad keys leak
+    # into attention and perturb the logits.
+    dec = jnp.zeros((1, 3), jnp.int32)
+    with_mask = m.forward(params, padded, dec, enc_mask=mask)
+    without = m.forward(params, padded, dec)
+    assert not np.allclose(
+        np.asarray(with_mask), np.asarray(without), atol=1e-5
+    )
+
+
+def test_spmd_t5_validates_mesh_and_divisibility(devices):
+    from defer_tpu.models.t5 import SpmdT5, spmd_t5
+    from defer_tpu.parallel.mesh import make_mesh
+
+    with pytest.raises(ValueError, match="mesh"):
+        SpmdT5(tiny_t5().cfg, mesh=None)
+    mesh = make_mesh({"model": 8}, devices)
+    with pytest.raises(ValueError, match="divide"):
+        spmd_t5(mesh, tiny_t5().cfg)  # 4 heads cannot shard over tp=8
+
+
 @pytest.mark.slow
 def test_hf_t5_bucket_parity():
     """Bucketing vs transformers' T5Attention._relative_position_bucket
@@ -244,6 +368,79 @@ def test_hf_t5_parity():
     np.testing.assert_allclose(enc_got, enc_want, rtol=2e-3, atol=2e-4)
     got = np.asarray(
         m.forward(params, jnp.asarray(enc_np), jnp.asarray(dec_np))
+    )
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-4)
+
+
+@pytest.mark.slow
+def test_hf_transplant_tie_mismatch_is_loud():
+    """A checkpoint whose head tying disagrees with the config must
+    raise — _head applies the tied-only dim**-0.5 scaling, so a silent
+    mismatch would put every logit off by sqrt(dim)."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    hf_cfg = transformers.T5Config(
+        vocab_size=96, d_model=32, d_kv=8, d_ff=64, num_layers=2,
+        num_heads=4, relative_attention_num_buckets=8,
+        relative_attention_max_distance=20, dropout_rate=0.0,
+        feed_forward_proj="relu", tie_word_embeddings=False,
+        decoder_start_token_id=0,
+    )
+    torch.manual_seed(3)
+    hf = transformers.T5ForConditionalGeneration(hf_cfg).eval()
+    with pytest.raises(ValueError, match="tie_word_embeddings"):
+        from_hf_state_dict(tiny_t5().cfg, hf.state_dict())  # cfg ties
+
+    tied = transformers.T5ForConditionalGeneration(
+        transformers.T5Config(
+            **{**hf_cfg.to_dict(), "tie_word_embeddings": True}
+        )
+    ).eval()
+    untied_cfg = tiny_t5(tie_word_embeddings=False).cfg
+    with pytest.raises(ValueError, match="tie_word_embeddings"):
+        from_hf_state_dict(untied_cfg, tied.state_dict())
+
+
+@pytest.mark.slow
+def test_hf_t5_masked_parity():
+    """Padded batch + attention_mask: logits parity with HF at every
+    REAL decoder position (HF masks with a large-negative constant
+    rather than -inf, so only real-token logits are comparable)."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    hf_cfg = transformers.T5Config(
+        vocab_size=96, d_model=32, d_kv=8, d_ff=64, num_layers=2,
+        num_heads=4, relative_attention_num_buckets=8,
+        relative_attention_max_distance=20, dropout_rate=0.0,
+        feed_forward_proj="relu", tie_word_embeddings=True,
+        decoder_start_token_id=0,
+    )
+    torch.manual_seed(2)
+    hf = transformers.T5ForConditionalGeneration(hf_cfg).eval()
+    m = tiny_t5()
+    params = from_hf_state_dict(m.cfg, hf.state_dict())
+
+    rs = np.random.RandomState(3)
+    enc_np = rs.randint(1, 96, size=(2, 8))
+    enc_np[0, 5:] = 0  # row 0 padded from length 5
+    mask_np = np.ones((2, 8), np.int64)
+    mask_np[0, 5:] = 0
+    dec_np = rs.randint(0, 96, size=(2, 4))
+    with torch.no_grad():
+        want = hf(
+            input_ids=torch.from_numpy(enc_np),
+            attention_mask=torch.from_numpy(mask_np),
+            decoder_input_ids=torch.from_numpy(dec_np),
+        ).logits.numpy()
+    got = np.asarray(
+        m.forward(
+            params,
+            jnp.asarray(enc_np),
+            jnp.asarray(dec_np),
+            enc_mask=jnp.asarray(mask_np),
+        )
     )
     np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-4)
 
