@@ -42,19 +42,60 @@ from defer_tpu.parallel.transformer_stack import (
 )
 
 
+def truncate_logits(
+    logits: jax.Array,
+    *,
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> jax.Array:
+    """Mask logits outside the sampling support to -inf.
+
+    top_k > 0 keeps the k highest logits (ties at the k-th value all
+    survive). top_p < 1 keeps the nucleus: tokens whose cumulative
+    probability mass, accumulated in descending-probability order,
+    is needed to first reach top_p (the top token always survives).
+    Both filters are static-shape (top_k / sort + cumsum), so the
+    policy jits into the decode step without host round trips.
+    """
+    neg = jnp.finfo(logits.dtype).min
+    if top_k and top_k < logits.shape[-1]:
+        kth = lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, neg, logits)
+    if top_p < 1.0:
+        desc = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(desc, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # A token stays iff the mass strictly before it is < top_p;
+        # the cutoff is the smallest surviving logit. Column 0 is the
+        # highest-probability token — pinned so even top_p <= 0 keeps
+        # it (otherwise everything masks and sampling turns uniform).
+        keep = (cum - probs) < top_p
+        keep = keep.at[..., 0].set(True)
+        cutoff = jnp.min(
+            jnp.where(keep, desc, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < cutoff, neg, logits)
+    return logits
+
+
 def sample_token(
     logits_last: jax.Array,
     rng: jax.Array,
     temperature: float,
+    *,
+    top_k: int = 0,
+    top_p: float = 1.0,
 ) -> tuple[jax.Array, jax.Array]:
     """One sampling policy for every decode loop (generate, examples):
-    greedy at temperature 0, categorical otherwise. Returns
-    (token_ids, next_rng)."""
+    greedy at temperature 0 (top_k/top_p ignored), otherwise
+    categorical over logits/temperature restricted by truncate_logits.
+    Returns (token_ids, next_rng)."""
     if temperature > 0:
         rng, sub = jax.random.split(rng)
-        tok = jax.random.categorical(
-            sub, logits_last / temperature, axis=-1
+        logits = truncate_logits(
+            logits_last / temperature, top_k=top_k, top_p=top_p
         )
+        tok = jax.random.categorical(sub, logits, axis=-1)
     else:
         tok = jnp.argmax(logits_last, axis=-1)
     return tok, rng
@@ -83,6 +124,13 @@ class GptDecoder:
             )
         if self.cfg.num_experts:
             raise ValueError("MoE decoder blocks are not supported here")
+        if self.cfg.lora_rank:
+            raise ValueError(
+                "GptDecoder serves merged weights only: fold adapters "
+                "with parallel.lora.merge_lora and build the decoder "
+                "from a lora_rank=0 config (same serving cost, no "
+                "adapter keys in the cacheable step)"
+            )
         if self.rolling_cache and (
             self.cfg.window is None or self.cfg.pos_style != "rope"
         ):
@@ -483,6 +531,8 @@ class GptDecoder:
         num_steps: int,
         *,
         temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
         rng: jax.Array | None = None,
         prefill_chunk: int | None = None,
     ) -> jax.Array:
@@ -511,7 +561,9 @@ class GptDecoder:
         if rng is None:
             rng = jax.random.key(0)
         for i in range(num_steps):
-            nxt, rng = sample_token(last, rng, temperature)
+            nxt, rng = sample_token(
+                last, rng, temperature, top_k=top_k, top_p=top_p
+            )
             nxt = nxt[:, None].astype(prompt_ids.dtype)
             ids = jnp.concatenate([ids, nxt], axis=1)
             if i + 1 < num_steps:

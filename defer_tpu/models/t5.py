@@ -663,6 +663,8 @@ class T5:
         num_steps: int,
         *,
         temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
         rng: jax.Array | None = None,
         enc_mask: jax.Array | None = None,
     ) -> jax.Array:
@@ -686,7 +688,9 @@ class T5:
             rng = jax.random.key(0)
         last, cache = self.prefill(params, cache, ids)
         for i in range(num_steps):
-            nxt, rng = sample_token(last, rng, temperature)
+            nxt, rng = sample_token(
+                last, rng, temperature, top_k=top_k, top_p=top_p
+            )
             nxt = nxt[:, None].astype(jnp.int32)
             ids = jnp.concatenate([ids, nxt], axis=1)
             if i + 1 < num_steps:
@@ -897,6 +901,28 @@ class SpmdT5(T5):
             return jax.jit(step, donate_argnums=(1,) if donate else ())
 
         return cached_step(self, ("step", donate), build)
+
+    def decode_logits(
+        self,
+        params: dict,
+        enc_out: jax.Array,
+        dec_ids: jax.Array,
+        tp_axis: str | None = None,
+        *,
+        enc_mask: jax.Array | None = None,
+    ) -> jax.Array:
+        """Direct (tp_axis=None) calls on shard_params output run the
+        same math under GSPMD, but the head is vocab-PADDED to a tp
+        multiple — slice the zero pad columns off so cross-entropy
+        shapes match and argmax can never emit a pad id. Per-shard
+        calls (tp_axis set, inside make_forward's shard_map) return
+        the local slice untouched."""
+        out = super().decode_logits(
+            params, enc_out, dec_ids, tp_axis, enc_mask=enc_mask
+        )
+        if tp_axis is None:
+            out = out[..., : self.cfg.vocab_size]
+        return out
 
 
 def spmd_t5(

@@ -1,0 +1,170 @@
+"""LoRA fine-tuning over the SPMD transformer stack.
+
+Beyond-reference capability (the reference is inference-only): low-rank
+adapters make fine-tuning a large frozen model cheap — only the
+[in, r] x [r, out] factor pairs train, so the optimizer state (the Adam
+moments that normally double a model's HBM cost) shrinks from O(model)
+to O(adapters), and the base weights can stay in bf16/int8 untouched.
+
+TPU-first shape of the design:
+
+  * adapter factors live INSIDE the stacked param tree
+    (``{target}:a`` / ``{target}:b``, init_stack), so the same
+    `lax.scan` block body, circular-ppermute pipeline, and Megatron
+    tensor-parallel shardings serve adapted and plain stacks — no
+    second code path. Column-parallel targets shard ``b`` over tp;
+    row-parallel targets shard ``a`` and ride the block's existing
+    psum (the low-rank path is linear, so the same collective closes
+    both partial sums).
+  * training splits the tree by suffix: `jax.value_and_grad` runs
+    ONLY over the adapter leaves (plus the task head), so backward
+    never materializes base-weight gradients, and the optimizer state
+    covers adapters only.
+  * serving merges: ``merge_lora`` folds ``w + scale * a @ b`` into
+    the base weights and drops the factor keys, producing a plain
+    stack any consumer (SpmdBert, GptDecoder KV-cache serving,
+    checkpointing) runs at exactly base-model cost.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from defer_tpu.parallel.train import TrainState, make_classifier_params
+
+
+def is_lora_key(name: str) -> bool:
+    return name.endswith(":a") or name.endswith(":b")
+
+
+def split_lora(params: dict) -> tuple[dict, dict]:
+    """Partition a param tree into (frozen base, trainable adapters).
+
+    Adapter leaves are the ``{target}:a`` / ``{target}:b`` entries of
+    the "stack" sub-dict; everything else (embeddings, norms, base
+    weights, pooler) is base. Both halves keep the same nesting so
+    ``combine_lora`` is a pure dict merge.
+    """
+    base = {k: v for k, v in params.items() if k != "stack"}
+    stack = params.get("stack", {})
+    base["stack"] = {k: v for k, v in stack.items() if not is_lora_key(k)}
+    lora = {"stack": {k: v for k, v in stack.items() if is_lora_key(k)}}
+    return base, lora
+
+
+def combine_lora(base: dict, lora: dict) -> dict:
+    """Inverse of split_lora: one tree the stack forward consumes."""
+    out = {k: v for k, v in base.items() if k != "stack"}
+    out["stack"] = {**base.get("stack", {}), **lora.get("stack", {})}
+    return out
+
+
+def merge_lora(params: dict, cfg) -> dict:
+    """Fold every adapter into its base weight: w <- w + scale * a @ b.
+
+    Returns a plain (adapter-free) tree — same keys a lora_rank=0
+    init_stack would produce — so serving, checkpointing, and the
+    KV-cache decoder run the fine-tuned model at base-model cost.
+    The contraction is over the trailing two axes, so both the flat
+    [L, ...] init_stack layout and the [S, L/S, ...] stage-stacked
+    layout (spmd_pipeline.stack_for_stages) merge unchanged.
+    """
+    scale = cfg.lora_scale
+    stack = dict(params.get("stack", {}))
+    for key in [k for k in stack if k.endswith(":a")]:
+        target = key[:-2]
+        a = stack.pop(key)
+        b = stack.pop(f"{target}:b")
+        w = stack[target]
+        delta = jnp.einsum(
+            "...ir,...ro->...io",
+            a.astype(jnp.float32),
+            b.astype(jnp.float32),
+        )
+        stack[target] = (w.astype(jnp.float32) + scale * delta).astype(
+            w.dtype
+        )
+    out = {k: v for k, v in params.items() if k != "stack"}
+    out["stack"] = stack
+    return out
+
+
+def make_lora_train_step(
+    sb,
+    optimizer: optax.GradientTransformation,
+    *,
+    num_classes: int,
+) -> tuple[
+    Callable[[jax.Array], tuple[TrainState, dict]],
+    Callable[
+        [TrainState, dict, jax.Array, jax.Array], tuple[TrainState, jax.Array]
+    ],
+]:
+    """LoRA counterpart of train.make_train_step.
+
+    Returns (init_state, train_step):
+
+      * ``init_state(rng) -> (state, base)``: ``state.params`` holds
+        ONLY the trainable leaves (adapter factors + classifier head)
+        and the optimizer state covers just those; ``base`` is the
+        frozen tree (reuse a pretrained checkpoint here).
+      * ``train_step(state, base, ids [M, B, S], labels [M, B])``:
+        grads flow through the full pipelined forward but only with
+        respect to the trainable leaves — base-weight gradients are
+        never built. ``base`` is passed (not closed over) so one
+        compiled step serves any checkpoint of the same shape.
+
+    sb.cfg.lora_rank must be > 0 (init_stack then creates the factor
+    keys this splits on).
+    """
+    if not sb.cfg.lora_rank:
+        raise ValueError(
+            "make_lora_train_step needs cfg.lora_rank > 0 — with no "
+            "adapter keys in the stack there is nothing to train"
+        )
+    forward = sb.make_step()
+
+    def loss_fn(trainable: dict, base: dict, ids, labels):
+        params = combine_lora(base, trainable)
+        pooled = forward(params, ids)  # [M, B, D]
+        logits = (
+            pooled.astype(jnp.float32) @ trainable["cls_w"]
+            + trainable["cls_b"]
+        )
+        losses = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        )
+        return losses.mean()
+
+    def init_state(rng: jax.Array):
+        base, lora = split_lora(sb.init(rng))
+        trainable = dict(lora)
+        trainable.update(
+            make_classifier_params(
+                jax.random.fold_in(rng, 17), sb, num_classes
+            )
+        )
+        state = TrainState(
+            params=trainable,
+            opt_state=optimizer.init(trainable),
+            step=jnp.zeros((), jnp.int32),
+        )
+        return state, base
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def train_step(state: TrainState, base: dict, ids, labels):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            state.params, base, ids, labels
+        )
+        updates, opt_state = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    return init_state, train_step

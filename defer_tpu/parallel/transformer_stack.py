@@ -81,10 +81,26 @@ class TransformerConfig:
     pos_style: str = "learned"  # "learned" table | "rope" (rotary q/k)
     use_bias: bool = True  # llama: no projection biases at all
     rope_theta: float = 10000.0
+    # -- LoRA (parallel/lora.py) ------------------------------------
+    # rank > 0 adds low-rank adapter factors {t}:a [in, r] / {t}:b
+    # [r, out] for each target projection; the forward adds
+    # scale * (x @ a) @ b to the frozen base matmul. b starts at zero,
+    # so a freshly-initialized adapter is an exact identity.
+    lora_rank: int = 0
+    lora_targets: tuple = ("wq", "wv")
+    lora_alpha: float | None = None  # scale = alpha / rank; None -> 1.0
 
     @property
     def kv_heads(self) -> int:
         return self.num_kv_heads or self.num_heads
+
+    @property
+    def lora_scale(self) -> float:
+        if not self.lora_rank:
+            return 0.0
+        if self.lora_alpha is None:
+            return 1.0
+        return self.lora_alpha / self.lora_rank
 
     def __post_init__(self):
         if self.num_heads % self.kv_heads:
@@ -113,6 +129,24 @@ class TransformerConfig:
                 f"moe_top_k={self.moe_top_k} must be in "
                 f"[1, num_experts={self.num_experts}]"
             )
+        if self.lora_rank:
+            if self.lora_rank < 1:
+                raise ValueError(f"lora_rank={self.lora_rank} must be >= 1")
+            valid = {"wq", "wk", "wv", "wo", "w1", "w2"}
+            if self.ffn_style == "swiglu":
+                valid.add("w3")
+            if self.num_experts:
+                # Expert FFN weights have an extra [E] axis the
+                # two-factor adapter doesn't model.
+                valid -= {"w1", "w2"}
+            bad = set(self.lora_targets) - valid
+            if bad:
+                raise ValueError(
+                    f"lora_targets {sorted(bad)} not adaptable for this "
+                    f"config (valid: {sorted(valid)})"
+                )
+            if not self.lora_targets:
+                raise ValueError("lora_rank set but lora_targets is empty")
         # Fail at construction, not as a KeyError deep inside jit
         # tracing (a typo'd knob would otherwise silently select the
         # wrong architecture or crash on a missing param key).
@@ -128,6 +162,29 @@ class TransformerConfig:
                 raise ValueError(
                     f"{field}={v!r}: must be one of {allowed}"
                 )
+
+
+#: Projections whose INPUT axis is tp-sharded (Megatron row-parallel,
+#: partial sums closed by the block's psum). Everything else adaptable
+#: is column-parallel (output features sharded).
+_ROW_PARALLEL = frozenset({"wo", "w2"})
+
+
+def lora_target_dims(cfg: TransformerConfig) -> dict:
+    """(in_dim, out_dim) for every projection an adapter can target."""
+    D, F = cfg.dim, cfg.ffn_dim
+    dkv = cfg.kv_heads * (D // cfg.num_heads)
+    dims = {
+        "wq": (D, D),
+        "wk": (D, dkv),
+        "wv": (D, dkv),
+        "wo": (D, D),
+        "w1": (D, F),
+        "w2": (F, D),
+    }
+    if cfg.ffn_style == "swiglu":
+        dims["w3"] = (D, F)
+    return dims
 
 
 def init_stack(
@@ -191,6 +248,20 @@ def init_stack(
         if cfg.use_bias:
             p["b1"] = jnp.zeros((L, F), dtype)
             p["b2"] = jnp.zeros((L, D), dtype)
+    if cfg.lora_rank:
+        r = cfg.lora_rank
+        dims = lora_target_dims(cfg)
+        for i, t in enumerate(cfg.lora_targets):
+            din, dout = dims[t]
+            p[f"{t}:a"] = (
+                jax.random.normal(
+                    jax.random.fold_in(rng, 100 + i), (L, din, r), dtype
+                )
+                * din**-0.5
+            )
+            # Zero b => a fresh adapter changes nothing: the fine-tune
+            # starts exactly at the pretrained model.
+            p[f"{t}:b"] = jnp.zeros((L, r, dout), dtype)
     return p
 
 
@@ -257,6 +328,19 @@ def stack_specs(
         if use_bias:
             p["b1"] = P(st, tp)
             p["b2"] = P(st, None)
+    if cfg is not None and cfg.lora_rank:
+        for t in cfg.lora_targets:
+            if t in _ROW_PARALLEL:
+                # Input sharded like the base weight's rows; x @ a is a
+                # partial sum the block's existing psum closes (the
+                # low-rank path rides the same collective by linearity).
+                p[f"{t}:a"] = P(st, tp, None)
+                p[f"{t}:b"] = P(st, None, None)
+            else:
+                # Rank axis replicated, output features tp-sharded like
+                # the base weight's columns.
+                p[f"{t}:a"] = P(st, None, None)
+                p[f"{t}:b"] = P(st, None, tp)
     return p
 
 
@@ -574,10 +658,22 @@ def block_apply(
     def bias(h, name):
         return h + p[name].astype(dt) if name in p else h
 
+    lora_scale = cfg.lora_scale
+
+    def proj(h, name):
+        """Base matmul plus the low-rank adapter path when present.
+        Under tp the adapter factors are sharded to match the base
+        weight (stack_specs), so no extra collective is needed."""
+        y = h @ p[name].astype(dt)
+        a = p.get(f"{name}:a")
+        if a is not None:
+            y = y + ((h @ a.astype(dt)) @ p[f"{name}:b"].astype(dt)) * lora_scale
+        return y
+
     a_in = norm_apply(cfg, x, p, "ln1") if pre else x
-    q = bias(a_in @ p["wq"].astype(dt), "bq")
-    k = bias(a_in @ p["wk"].astype(dt), "bk")
-    v = bias(a_in @ p["wv"].astype(dt), "bv")
+    q = bias(proj(a_in, "wq"), "bq")
+    k = bias(proj(a_in, "wk"), "bk")
+    v = bias(proj(a_in, "wv"), "bv")
     if cfg.pos_style == "rope":
         s_local = q.shape[1]
         offset = (
@@ -601,7 +697,7 @@ def block_apply(
         sp_axis=sp_axis,
         sp_strategy=sp_strategy,
     )
-    attn = attn @ p["wo"].astype(dt)
+    attn = proj(attn, "wo")
     if tp_axis is not None:
         attn = lax.psum(attn, tp_axis)
     attn = bias(attn, "bo")
@@ -632,14 +728,14 @@ def block_apply(
             )
     elif cfg.ffn_style == "swiglu":
         # llama FFN: silu(gate) * up -> down (w1=gate, w3=up, w2=down).
-        gate = jax.nn.silu(f_in @ p["w1"].astype(dt))
-        h = (gate * (f_in @ p["w3"].astype(dt))) @ p["w2"].astype(dt)
+        gate = jax.nn.silu(proj(f_in, "w1"))
+        h = proj(gate * proj(f_in, "w3"), "w2")
         if tp_axis is not None:
             h = lax.psum(h, tp_axis)
     else:
-        h = bias(f_in @ p["w1"].astype(dt), "b1")
+        h = bias(proj(f_in, "w1"), "b1")
         h = jax.nn.gelu(h)
-        h = h @ p["w2"].astype(dt)
+        h = proj(h, "w2")
         if tp_axis is not None:
             h = lax.psum(h, tp_axis)
         h = bias(h, "b2")

@@ -47,6 +47,8 @@ def main() -> None:
     ap.add_argument("--steps", type=int, default=64)
     ap.add_argument("--tp", type=int, default=1)
     ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--top-k", type=int, default=0)
+    ap.add_argument("--top-p", type=float, default=1.0)
     ap.add_argument(
         "--family",
         choices=("gpt", "llama"),
@@ -133,7 +135,13 @@ def main() -> None:
     rng = jax.random.key(7)
 
     def pick(logits_last, rng):
-        tok, rng = sample_token(logits_last, rng, args.temperature)
+        tok, rng = sample_token(
+            logits_last,
+            rng,
+            args.temperature,
+            top_k=args.top_k,
+            top_p=args.top_p,
+        )
         return tok.astype(prompt.dtype), rng
 
     nxt, rng = pick(logits[:, -1:], rng)

@@ -114,6 +114,84 @@ def test_sampled_generation_respects_temperature():
     assert not np.array_equal(np.asarray(a), np.asarray(c))
 
 
+def test_truncate_logits_top_k():
+    """top_k keeps exactly the k largest logits; the rest drop to the
+    dtype floor so categorical can never pick them."""
+    from defer_tpu.models.gpt import truncate_logits
+
+    logits = jnp.array([[0.0, 3.0, 1.0, 2.0, -1.0]])
+    out = np.asarray(truncate_logits(logits, top_k=2))
+    neg = np.finfo(np.float32).min
+    np.testing.assert_allclose(out[0], [neg, 3.0, neg, 2.0, neg])
+    # k >= vocab is a no-op
+    np.testing.assert_allclose(
+        np.asarray(truncate_logits(logits, top_k=5)), np.asarray(logits)
+    )
+
+
+def test_truncate_logits_top_p():
+    """Nucleus: tokens are kept in descending-probability order until
+    the cumulative mass first reaches top_p; the top token always
+    survives even for tiny top_p."""
+    from defer_tpu.models.gpt import truncate_logits
+
+    # softmax of these is ~[0.474, 0.474, 0.047, 0.005]
+    logits = jnp.log(jnp.array([[10.0, 10.0, 1.0, 0.1]]))
+    neg = np.finfo(np.float32).min
+    out = np.asarray(truncate_logits(logits, top_p=0.9))
+    # 0.474 + 0.474 = 0.948 >= 0.9 -> first two survive, rest masked
+    assert out[0, 0] > neg / 2 and out[0, 1] > neg / 2
+    assert out[0, 2] == neg and out[0, 3] == neg
+
+    tiny = np.asarray(truncate_logits(logits, top_p=1e-6))
+    # only the argmax-tied top tokens survive
+    assert (tiny[0, :2] > neg / 2).any()
+    assert tiny[0, 2] == neg and tiny[0, 3] == neg
+
+    # Degenerate top_p=0 still keeps the top token instead of masking
+    # everything (which would silently sample uniformly).
+    zero = np.asarray(truncate_logits(jnp.array([[0.0, 3.0, 1.0]]), top_p=0.0))
+    assert zero[0, 1] > neg / 2
+    assert zero[0, 0] == neg and zero[0, 2] == neg
+
+
+def test_sample_token_top_k_restricts_support():
+    """Sampling with top_k=2 at high temperature only ever emits the
+    two highest-logit ids; top_k=1 is exactly greedy."""
+    from defer_tpu.models.gpt import sample_token
+
+    logits = jnp.array([[0.0, 5.0, 4.9, 1.0, 2.0]])
+    rng = jax.random.key(0)
+    seen = set()
+    for _ in range(64):
+        tok, rng = sample_token(logits, rng, 5.0, top_k=2)
+        seen.add(int(tok[0]))
+    assert seen <= {1, 2} and len(seen) == 2
+
+    tok, _ = sample_token(logits, jax.random.key(3), 5.0, top_k=1)
+    assert int(tok[0]) == 1
+
+
+def test_generate_with_nucleus_sampling():
+    """End-to-end: generate with temperature + top_k + top_p is
+    reproducible under a fixed rng and stays in-vocab."""
+    dec = tiny_gpt()
+    params = dec.init(jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(1), (2, 4), 0, 128)
+    a = dec.generate(
+        params, prompt, 8, temperature=0.8, top_k=40, top_p=0.95,
+        rng=jax.random.key(7),
+    )
+    b = dec.generate(
+        params, prompt, 8, temperature=0.8, top_k=40, top_p=0.95,
+        rng=jax.random.key(7),
+    )
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert a.shape == (2, 12)
+    toks = np.asarray(a)
+    assert toks.min() >= 0 and toks.max() < 128
+
+
 def test_tp_sharded_decode_matches_single_device(devices):
     """SpmdGptDecoder over model=2: head-sharded caches + Megatron
     projections reproduce the single-device decoder exactly, through

@@ -1,0 +1,182 @@
+"""LoRA adapters over the SPMD stack: identity at init, merge/unmerged
+equivalence, frozen-base training, and tensor-parallel sharding."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from defer_tpu.models.bert import SpmdBert
+from defer_tpu.parallel.lora import (
+    combine_lora,
+    make_lora_train_step,
+    merge_lora,
+    split_lora,
+)
+from defer_tpu.parallel.mesh import make_mesh
+from defer_tpu.parallel.transformer_stack import TransformerConfig
+
+
+def _cfg(**kw):
+    base = dict(
+        num_layers=2, dim=32, num_heads=4, ffn_dim=64, vocab_size=64,
+        max_len=32,
+    )
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def _randomize_b(params, rng, scale=0.3):
+    """Give the zero-init b factors real values so adapters do work."""
+    stack = dict(params["stack"])
+    for i, k in enumerate(sorted(stack)):
+        if k.endswith(":b"):
+            stack[k] = (
+                jax.random.normal(
+                    jax.random.fold_in(rng, i), stack[k].shape
+                )
+                * scale
+            )
+    return {**params, "stack": stack}
+
+
+def test_config_validates_targets():
+    with pytest.raises(ValueError, match="not adaptable"):
+        _cfg(lora_rank=4, lora_targets=("wq", "w3"))  # w3 is swiglu-only
+    with pytest.raises(ValueError, match="not adaptable"):
+        _cfg(lora_rank=4, lora_targets=("w1",), num_experts=2)
+    with pytest.raises(ValueError, match="empty"):
+        _cfg(lora_rank=4, lora_targets=())
+    cfg = _cfg(lora_rank=4, lora_alpha=8.0)
+    assert cfg.lora_scale == 2.0
+    assert _cfg().lora_scale == 0.0
+
+
+def test_fresh_adapter_is_identity(devices):
+    """b = 0 at init: a lora-enabled stack computes exactly what the
+    base stack computes from the same rng."""
+    mesh = make_mesh({"stage": 1}, devices[:1])
+    cfg_l = _cfg(lora_rank=4, lora_targets=("wq", "wv", "w1", "w2"))
+    sb_l = SpmdBert(mesh, cfg_l, compute_dtype=jnp.float32)
+    sb_0 = SpmdBert(mesh, _cfg(), compute_dtype=jnp.float32)
+    p_l = sb_l.init(jax.random.key(0))
+    p_0 = sb_0.init(jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (1, 2, 16), 0, 64)
+    out_l = sb_l.make_step()(p_l, ids)
+    out_0 = sb_0.make_step()(p_0, ids)
+    np.testing.assert_allclose(
+        np.asarray(out_l), np.asarray(out_0), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_merge_matches_unmerged(devices):
+    """Folding w + scale * a @ b into the base weights reproduces the
+    unmerged adapter forward, and drops every factor key."""
+    mesh = make_mesh({"stage": 1}, devices[:1])
+    cfg = _cfg(
+        lora_rank=4,
+        lora_alpha=8.0,
+        lora_targets=("wq", "wk", "wv", "wo", "w1", "w2"),
+    )
+    sb = SpmdBert(mesh, cfg, compute_dtype=jnp.float32)
+    params = _randomize_b(sb.init(jax.random.key(0)), jax.random.key(2))
+    ids = jax.random.randint(jax.random.key(1), (1, 2, 16), 0, 64)
+    want = sb.make_step()(params, ids)
+
+    merged = merge_lora(params, cfg)
+    assert not any(":" in k for k in merged["stack"])
+    sb_0 = SpmdBert(mesh, _cfg(), compute_dtype=jnp.float32)
+    got = sb_0.make_step()(merged, ids)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_split_combine_roundtrip(devices):
+    mesh = make_mesh({"stage": 1}, devices[:1])
+    cfg = _cfg(lora_rank=2)
+    sb = SpmdBert(mesh, cfg, compute_dtype=jnp.float32)
+    params = sb.init(jax.random.key(0))
+    base, lora = split_lora(params)
+    assert set(lora["stack"]) == {"wq:a", "wq:b", "wv:a", "wv:b"}
+    assert not any(":" in k for k in base["stack"])
+    back = combine_lora(base, lora)
+    assert set(back["stack"]) == set(params["stack"])
+
+
+def test_lora_train_freezes_base(devices):
+    """The LoRA step trains only adapters + head: loss drops, base
+    weights are untouched, and the optimizer state is adapter-sized."""
+    mesh = make_mesh({"stage": 2, "data": 2}, devices[:4])
+    cfg = _cfg(lora_rank=4, lora_targets=("wq", "wv", "w1", "w2"))
+    sb = SpmdBert(mesh, cfg, compute_dtype=jnp.float32)
+    init_state, step = make_lora_train_step(
+        sb, optax.adam(5e-2), num_classes=4
+    )
+    state, base = init_state(jax.random.key(0))
+    base_before = jax.tree_util.tree_map(lambda x: np.asarray(x), base)
+
+    # Optimizer state covers only the trainable leaves.
+    n_trainable = len(jax.tree_util.tree_leaves(state.params))
+    n_opt = len(jax.tree_util.tree_leaves(state.opt_state[0].mu))
+    assert n_opt == n_trainable
+
+    ids = jax.random.randint(jax.random.key(1), (3, 4, 16), 0, 64)
+    labels = jax.random.randint(jax.random.key(2), (3, 4), 0, 4)
+    losses = []
+    for _ in range(8):
+        state, loss = step(state, base, ids, labels)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), b),
+        base,
+        base_before,
+    )
+    assert int(state.step) == 8
+
+
+def test_lora_tp_matches_single_device(devices):
+    """Adapter factors shard with their base weights: a tp=2 pipeline
+    forward equals the unsharded forward with the same params."""
+    cfg = _cfg(
+        lora_rank=4,
+        lora_targets=("wq", "wo", "w1", "w2"),
+        lora_alpha=4.0,
+    )
+    mesh_1 = make_mesh({"stage": 1}, devices[:1])
+    sb_1 = SpmdBert(mesh_1, cfg, compute_dtype=jnp.float32)
+    params = _randomize_b(sb_1.init(jax.random.key(0)), jax.random.key(2))
+    ids = jax.random.randint(jax.random.key(1), (1, 2, 16), 0, 64)
+    want = sb_1.make_step()(params, ids)
+
+    mesh_tp = make_mesh({"stage": 2, "model": 2}, devices[:4])
+    sb_tp = SpmdBert(mesh_tp, cfg, compute_dtype=jnp.float32)
+    host = jax.tree_util.tree_map(np.asarray, params)
+    # Re-place the single-device tree onto the tp mesh shardings by
+    # initializing for structure and device_put-ing the numbers.
+    template = sb_tp.init(jax.random.key(0))
+    # The stage-1 tree stacks layers as [1, L, ...]; the stage-2
+    # template as [2, L/2, ...] — same layer order, so a reshape
+    # re-stacks losslessly.
+    placed = jax.tree_util.tree_map(
+        lambda t, v: jax.device_put(
+            jnp.asarray(v).reshape(t.shape), t.sharding
+        ),
+        template,
+        host,
+    )
+    got = sb_tp.make_step()(placed, ids)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_decoder_rejects_unmerged_lora():
+    from defer_tpu.models.gpt import GptDecoder
+
+    cfg = _cfg(norm_style="pre", causal=True, lora_rank=2)
+    with pytest.raises(ValueError, match="merge"):
+        GptDecoder(cfg)

@@ -235,6 +235,28 @@ def test_tp_teacher_forced_forward_matches(devices):
     )
 
 
+def test_tp_direct_forward_slices_pad_vocab(devices):
+    """Calling the inherited training forward directly on shard_params
+    output (GSPMD, no shard_map) must also hide the tp vocab padding:
+    [B, T, 97], not [B, T, 98], and match the single-device logits."""
+    from defer_tpu.models.t5 import spmd_t5
+    from defer_tpu.parallel.mesh import make_mesh
+
+    single = tiny_t5(vocab_size=97)
+    params = single.init(jax.random.key(0))
+    enc_ids = jax.random.randint(jax.random.key(1), (2, 6), 1, 97)
+    dec_ids = jax.random.randint(jax.random.key(2), (2, 4), 0, 97)
+    want = single.forward(params, enc_ids, dec_ids)
+
+    mesh = make_mesh({"model": 2}, devices[:2])
+    tp = spmd_t5(mesh, single.cfg, compute_dtype=jnp.float32)
+    got = tp.forward(tp.shard_params(params), enc_ids, dec_ids)
+    assert got.shape == (2, 4, 97)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5
+    )
+
+
 def test_all_pad_row_stays_finite():
     """A zero-length input (all-pad mask row) must not poison the
     batch with NaN — the finite mask constant keeps its logits
