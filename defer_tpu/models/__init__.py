@@ -120,6 +120,7 @@ def _load_zoo() -> None:
         "resnet",
         "vgg",
         "vit",
+        "xception",
     ):
         importlib.import_module(f"defer_tpu.models.{mod}")
 

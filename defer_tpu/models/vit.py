@@ -173,7 +173,9 @@ class SpmdVit:
         self.num_tokens = self.grid * self.grid + 1
 
     def _stack_param_specs(self):
-        return staged_specs(stack_specs(None, self.tp_axis), "stage")
+        return staged_specs(
+            stack_specs(None, self.tp_axis, cfg=self.cfg), "stage"
+        )
 
     def init(self, rng: jax.Array) -> dict:
         from jax.sharding import NamedSharding

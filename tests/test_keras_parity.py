@@ -49,6 +49,7 @@ _BUILDERS = {
         weights=None
     ),
     "nasnet_mobile": lambda: tf.keras.applications.NASNetMobile(weights=None),
+    "xception": lambda: tf.keras.applications.Xception(weights=None),
 }
 
 
@@ -94,6 +95,7 @@ def _assert_close(y_jax, y_tf, name):
         "efficientnet_b0",
         "inception_resnet_v2",
         "nasnet_mobile",
+        "xception",
     ],
 )
 def test_json_plus_h5_reproduces_tf_forward(name, keras_artifacts):
@@ -128,6 +130,52 @@ def test_native_zoo_consumes_real_checkpoint(name, keras_artifacts):
         x = x / 255.0
     y = model.graph.apply(params, x)
     _assert_close(y, y_tf, name)
+
+
+def test_native_xception_matches_tf(keras_artifacts):
+    """The hand-built Xception graph reproduces a real tf.keras
+    Xception forward from its checkpoint. Keras auto-names the four
+    residual-shortcut conv/BN pairs with global counters (`conv2d_7`
+    if other models were built first), so the map resolves them from
+    THIS model's JSON layer order instead of trusting fresh-session
+    numbering."""
+    import json as _json
+
+    from defer_tpu.models.xception import _RES_ORDER
+
+    json_str, weights_path, y_tf, x = keras_artifacts("xception")
+    layers = _json.loads(json_str)["config"]["layers"]
+    auto_convs = [
+        l["config"]["name"]
+        for l in layers
+        if l["class_name"] == "Conv2D"
+        and l["config"]["name"].startswith("conv2d")
+    ]
+    auto_bns = [
+        l["config"]["name"]
+        for l in layers
+        if l["class_name"] == "BatchNormalization"
+        and l["config"]["name"].startswith("batch_normalization")
+    ]
+    assert len(auto_convs) == len(auto_bns) == len(_RES_ORDER)
+    remap = {f"{blk}_res_conv": cn for blk, cn in zip(_RES_ORDER, auto_convs)}
+    remap |= {f"{blk}_res_bn": bn for blk, bn in zip(_RES_ORDER, auto_bns)}
+
+    model = get_model("xception")
+    def name_map(node, _inner=model.keras_name_map):
+        return remap.get(node, _inner(node))
+
+    base = model.init(jax.random.key(0))
+    params = transplant(
+        model.graph,
+        base,
+        KerasWeights(
+            load_keras_h5(weights_path, json_str), name_map=name_map
+        ),
+        strict=True,
+    )
+    y = model.graph.apply(params, x)
+    _assert_close(y, y_tf, "xception")
 
 
 def test_imported_nasnet_pipelines_via_bundle_discovery(keras_artifacts):

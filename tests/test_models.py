@@ -54,6 +54,7 @@ def test_resnet50_has_16_adds():
         ("inceptionv3", 96, 2048),
         ("inception_resnet_v2", 96, 1536),
         ("nasnet_mobile", 96, 1056),
+        ("xception", 96, 2048),
     ],
 )
 def test_new_zoo_builds_with_expected_head(name, res, feat):
@@ -70,7 +71,7 @@ def test_new_zoo_builds_with_expected_head(name, res, feat):
 
 @pytest.mark.parametrize(
     "name", ["mobilenetv2", "efficientnet_b0", "inceptionv3",
-             "inception_resnet_v2"]
+             "inception_resnet_v2", "xception"]
 )
 def test_new_zoo_cuts_are_valid(name):
     model = get_model(name)

@@ -107,6 +107,24 @@ def test_spmd_vit_pipeline_matches_reference(devices):
     )
 
 
+def test_spmd_vit_inits_with_lora(devices):
+    """A LoRA-enabled config must produce matching param/spec trees
+    (ViT fine-tuning is a primary adapter use-case)."""
+    import dataclasses
+
+    mesh = make_mesh({"stage": 2, "model": 2}, devices[:4])
+    cfg = dataclasses.replace(_cfg(), lora_rank=4)
+    sv = SpmdVit(
+        mesh, cfg, image_size=16, patch_size=4, num_classes=5,
+        compute_dtype=jnp.float32,
+    )
+    params = sv.init(jax.random.key(0))
+    assert "wq:a" in params["stack"] and "wv:b" in params["stack"]
+    images = jax.random.normal(jax.random.key(1), (2, 2, 16, 16, 3))
+    out = sv.make_step()(params, images)
+    assert out.shape == (2, 2, 5)
+
+
 def test_spmd_vit_validates_config(devices):
     mesh = make_mesh({"stage": 2}, devices[:2])
     import pytest
