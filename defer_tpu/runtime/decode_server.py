@@ -22,10 +22,22 @@ queued request:
     their position is pinned back to 0 after each tick.
 
 Greedy only, and each request's output is BIT-IDENTICAL to a solo
-`dec.generate` of that request — the correctness contract the tests
-pin. The reference's serving story is a fixed stream of identical
-CNN frames (reference src/test.py:30-41); this is the autoregressive
+`dec.generate` of that request at the tested scales — the correctness
+contract the tests pin. (At large widths/vocabs with random weights,
+greedy decoding itself is ill-conditioned: near-ties in the softmax
+mean the bucketed/offset prefill's different-but-equivalent reduction
+shapes can flip an argmax; examples/serve_decode.py --check therefore
+verifies greedy-validity under a tie tolerance instead.) The
+reference's serving story is a fixed stream of identical CNN frames
+(reference src/test.py:30-41); this is the autoregressive
 counterpart, composing with runtime/batching.py's request coalescing.
+
+Prefix caching (`prefix_ids=`): serving workloads share a system
+prompt; its K/V rows are identical for every request, so the server
+prefills the prefix ONCE into a one-lane cache and each admission
+copies that lane and prefills only the request's suffix — admission
+cost drops from O(prefix + prompt) to O(prompt) while outputs stay
+bit-identical to solo generation over the concatenated ids.
 """
 
 from __future__ import annotations
@@ -55,6 +67,7 @@ class DecodeServer:
         params: dict,
         *,
         max_batch: int = 4,
+        prefix_ids: jax.Array | None = None,
     ):
         self.dec = dec
         self.params = params
@@ -63,6 +76,26 @@ class DecodeServer:
         cache = dec.init_cache(max_batch)
         cache["pos"] = jnp.zeros((max_batch,), jnp.int32)
         self.cache = cache
+        self.prefix_len = 0
+        self._prefix_cache = None
+        if prefix_ids is not None:
+            if getattr(dec, "rolling_cache", False):
+                raise ValueError(
+                    "prefix caching over a rolling cache is not "
+                    "supported (prefix rows would be recycled)"
+                )
+            if prefix_ids.ndim != 2 or prefix_ids.shape[0] != 1:
+                raise ValueError("prefix_ids must be [1, P]")
+            self.prefix_len = int(prefix_ids.shape[1])
+            if self.prefix_len >= dec.cfg.max_len:
+                raise ValueError(
+                    f"prefix of {self.prefix_len} leaves no room under "
+                    f"max_len {dec.cfg.max_len}"
+                )
+            # One shared prefill; every admission copies this lane.
+            pre = dec.init_cache(1)
+            _, pre = self.step(params, pre, prefix_ids)
+            self._prefix_cache = pre
         self.slots = [_Slot() for _ in range(max_batch)]
         self.pending: list[tuple[int, jax.Array, int]] = []
         self.done: dict[int, jax.Array] = {}
@@ -84,10 +117,10 @@ class DecodeServer:
                 f"num_steps={num_steps}: need at least one generated "
                 "token (a non-positive count would never complete)"
             )
-        if t0 + num_steps > self.dec.cfg.max_len:
+        if self.prefix_len + t0 + num_steps > self.dec.cfg.max_len:
             raise ValueError(
-                f"prompt {t0} + steps {num_steps} exceeds max_len "
-                f"{self.dec.cfg.max_len}"
+                f"prefix {self.prefix_len} + prompt {t0} + steps "
+                f"{num_steps} exceeds max_len {self.dec.cfg.max_len}"
             )
         rid = self._next_id
         self._next_id += 1
@@ -111,16 +144,25 @@ class DecodeServer:
                 continue
             rid, prompt, steps = self.pending.pop(0)
             t0 = prompt.shape[1]
+            P = self.prefix_len
             # Bucketed prefill keeps the compiled-shape set small.
             pad = 1 << (t0 - 1).bit_length()
-            pad = min(pad, self.dec.cfg.max_len)
+            pad = min(pad, self.dec.cfg.max_len - P)
             padded = jnp.concatenate(
                 [prompt, jnp.zeros((1, pad - t0), prompt.dtype)], axis=1
             )
-            small = self.dec.init_cache(1)
+            if self._prefix_cache is None:
+                small = self.dec.init_cache(1)
+            else:
+                # Copy the shared-prefix lane (self.step donates its
+                # cache argument, so the master copy must not be
+                # handed over). The suffix then prefills at offset P.
+                small = jax.tree_util.tree_map(
+                    jnp.array, self._prefix_cache
+                )
             logits, small = self.step(self.params, small, padded)
-            # Insert the lane: K/V rows land in slot i; rows past t0
-            # are stale but position-masked until overwritten.
+            # Insert the lane: K/V rows land in slot i; rows past
+            # P + t0 are stale but position-masked until overwritten.
             self.cache = {
                 "k": jax.lax.dynamic_update_slice(
                     self.cache["k"], small["k"], (0, i, 0, 0, 0)
@@ -128,7 +170,7 @@ class DecodeServer:
                 "v": jax.lax.dynamic_update_slice(
                     self.cache["v"], small["v"], (0, i, 0, 0, 0)
                 ),
-                "pos": self.cache["pos"].at[i].set(t0),
+                "pos": self.cache["pos"].at[i].set(P + t0),
             }
             first = jnp.argmax(logits[:, t0 - 1, :], axis=-1)[
                 :, None
@@ -184,12 +226,23 @@ def serve_greedy(
     requests: list[tuple[jax.Array, int]],
     *,
     max_batch: int = 4,
+    prefix_ids: jax.Array | None = None,
 ) -> tuple[list[jax.Array], dict]:
     """One-shot convenience: serve `[(prompt, steps), ...]`, returning
     outputs in submission order plus stats (`ticks` batched decode
-    steps taken vs `solo_steps` a per-request loop would take)."""
-    srv = DecodeServer(dec, params, max_batch=max_batch)
+    steps taken vs `solo_steps` a per-request loop would take; with a
+    shared prefix, `saved_prefill_tokens` counts the K/V rows each
+    admission reused instead of recomputing). With `prefix_ids`, each
+    prompt is the per-request SUFFIX and outputs cover suffix +
+    generation (the prefix ids are not repeated in the result)."""
+    srv = DecodeServer(
+        dec, params, max_batch=max_batch, prefix_ids=prefix_ids
+    )
     rids = [srv.submit(p, s) for p, s in requests]
     done = srv.run()
-    stats = {"ticks": srv.ticks, "solo_steps": srv.solo_steps}
+    stats = {
+        "ticks": srv.ticks,
+        "solo_steps": srv.solo_steps,
+        "saved_prefill_tokens": srv.prefix_len * len(requests),
+    }
     return [done[r] for r in rids], stats

@@ -68,6 +68,62 @@ def test_submit_validation():
         srv.submit(jnp.zeros((1, 3), jnp.int32), 0)
 
 
+@pytest.mark.parametrize("family", ["gpt", "llama"])
+def test_prefix_cached_serving_matches_solo(family):
+    """With a shared system prefix, every served suffix+generation is
+    bit-identical to solo-decoding the CONCATENATED prompt — the
+    copied prefix K/V lane, offset suffix prefill, and per-slot
+    positions must all agree (learned positions for gpt, rotary for
+    llama)."""
+    dec = tiny_gpt(64) if family == "gpt" else tiny_llama(64)
+    params = dec.init(jax.random.key(0))
+    prefix = jnp.asarray([[7, 3, 1, 12, 9, 2]], jnp.int32)
+    reqs = _requests(dec.cfg.vocab_size)
+    outs, stats = serve_greedy(
+        dec, params, reqs, max_batch=2, prefix_ids=prefix
+    )
+    P = prefix.shape[1]
+    for (suffix, steps), got in zip(reqs, outs):
+        full = jnp.concatenate([prefix, suffix], axis=1)
+        want = dec.generate(params, full, steps)[:, P:]
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(want),
+            err_msg=f"{family} suffix={np.asarray(suffix)} steps={steps}",
+        )
+    assert stats["saved_prefill_tokens"] == P * len(reqs)
+
+
+def test_prefix_validation():
+    dec = tiny_gpt(32)
+    params = dec.init(jax.random.key(0))
+    with pytest.raises(ValueError, match=r"\[1, P\]"):
+        DecodeServer(dec, params, prefix_ids=jnp.zeros((3,), jnp.int32))
+    with pytest.raises(ValueError, match="no room"):
+        DecodeServer(dec, params, prefix_ids=jnp.zeros((1, 32), jnp.int32))
+    srv = DecodeServer(
+        dec, params, max_batch=2, prefix_ids=jnp.zeros((1, 10), jnp.int32)
+    )
+    with pytest.raises(ValueError, match="prefix 10"):
+        srv.submit(jnp.zeros((1, 4), jnp.int32), 19)  # 10+4+19 > 32
+
+    from defer_tpu.models.llama import mistral_config
+    from defer_tpu.models.gpt import GptDecoder
+
+    rolling = GptDecoder(
+        mistral_config(
+            num_layers=2, dim=32, num_heads=4, num_kv_heads=2,
+            ffn_dim=64, vocab_size=64, max_len=32, window=8,
+        ),
+        rolling_cache=True,
+    )
+    with pytest.raises(ValueError, match="rolling"):
+        DecodeServer(
+            rolling,
+            rolling.init(jax.random.key(0)),
+            prefix_ids=jnp.zeros((1, 4), jnp.int32),
+        )
+
+
 def test_server_serves_int8_params():
     """Continuous batching composes with weight-only int8: quantized
     param trees flow through per-slot ticks unchanged."""

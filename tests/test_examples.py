@@ -52,10 +52,11 @@ def test_serve_decode_example_checked():
             "examples/serve_decode.py", "--layers", "2", "--dim", "64",
             "--heads", "4", "--ffn", "128", "--vocab", "96",
             "--max-len", "128", "--requests", "4", "--slots", "2",
-            "--check",
+            "--prefix", "6", "--check",
         ]
     )
-    assert "outputs equal solo decodes" in out
+    assert "valid greedy choices" in out
+    assert "prefill tokens reused" in out
 
 
 def test_pretrained_example_skips_cleanly_offline():
