@@ -130,6 +130,11 @@ class SpmdBert:
     cfg: TransformerConfig
     compute_dtype: Any = jnp.bfloat16
     sp_strategy: str = "ring"
+    # FSDP: additionally shard each stack weight over the "data" axis
+    # (at-rest memory 1/dp per chip) and all-gather it just in time in
+    # the block body (transformer_stack.layers_apply) — the gather's
+    # transpose is the reduce-scatter sharded gradients need.
+    fsdp: bool = False
 
     def __post_init__(self):
         if "stage" not in self.mesh.axis_names:
@@ -169,18 +174,36 @@ class SpmdBert:
                 f"num_kv_heads={self.cfg.kv_heads} must divide by the "
                 f"model axis size {tp} (whole kv head groups per shard)"
             )
+        self._fsdp_plan: dict = {}
+        if self.fsdp:
+            dp = self.mesh.shape.get("data", 1)
+            if dp <= 1:
+                raise ValueError(
+                    "fsdp=True needs a 'data' mesh axis of size > 1 "
+                    "(there is nothing to shard the weights over)"
+                )
+            from defer_tpu.parallel.transformer_stack import fsdp_plan
+
+            self._fsdp_plan = fsdp_plan(
+                self.cfg, self._per_layer_specs(), dp
+            )
+
+    def _per_layer_specs(self):
+        return stack_specs(
+            None,
+            self.tp_axis,
+            ep_axis=self.ep_axis,
+            moe=bool(self.cfg.num_experts),
+            cfg=self.cfg,
+        )
 
     def _stack_param_specs(self):
-        return staged_specs(
-            stack_specs(
-                None,
-                self.tp_axis,
-                ep_axis=self.ep_axis,
-                moe=bool(self.cfg.num_experts),
-                cfg=self.cfg,
-            ),
-            "stage",
-        )
+        per_layer = self._per_layer_specs()
+        if self._fsdp_plan:
+            from defer_tpu.parallel.transformer_stack import fsdp_specs
+
+            per_layer = fsdp_specs(per_layer, self._fsdp_plan, "data")
+        return staged_specs(per_layer, "stage")
 
     def _stack_shardings(self):
         from jax.sharding import NamedSharding
@@ -243,6 +266,8 @@ class SpmdBert:
                 sp_axis=self.sp_axis,
                 sp_strategy=self.sp_strategy,
                 ep_axis=self.ep_axis,
+                fsdp_axis="data" if self._fsdp_plan else None,
+                fsdp_gather=self._fsdp_plan,
             )
 
         pipe = make_spmd_pipeline(

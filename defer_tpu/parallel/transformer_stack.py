@@ -344,6 +344,50 @@ def stack_specs(
     return p
 
 
+def fsdp_plan(
+    cfg: TransformerConfig, per_layer_specs: dict, dp: int
+) -> dict:
+    """FSDP placement: {param key -> per-layer dim index} to shard over
+    the data axis (and to all-gather back on use).
+
+    For each stack leaf, pick the first dimension the per-layer spec
+    leaves unsharded whose size the data-axis size divides — shapes
+    come from an eval_shape of init_stack, so every key the config
+    produces (biases, norms, MoE experts, LoRA factors) is planned by
+    the same rule. Leaves with no eligible dim (e.g. tp-sharded
+    biases) stay as they are: FSDP is a per-leaf memory optimization,
+    not an all-or-nothing mode.
+    """
+    if dp <= 1:
+        return {}
+    shapes = jax.eval_shape(
+        lambda k: init_stack(k, cfg), jax.random.key(0)
+    )
+    plan: dict = {}
+    for key, leaf in shapes.items():
+        spec = list(per_layer_specs[key])
+        dims = leaf.shape[1:]  # drop the stacked layer axis
+        spec += [None] * (len(dims) - (len(spec) - 1))
+        for i, dim in enumerate(dims):
+            if spec[i + 1] is None and dim % dp == 0 and dim >= dp:
+                plan[key] = i
+                break
+    return plan
+
+
+def fsdp_specs(per_layer_specs: dict, plan: dict, data_axis: str) -> dict:
+    """Apply an fsdp_plan to per-layer PartitionSpecs: entry
+    plan[key]+1 (after the layer axis) becomes the data axis."""
+    out = dict(per_layer_specs)
+    for key, axis in plan.items():
+        spec = list(out[key])
+        while len(spec) < axis + 2:
+            spec.append(None)
+        spec[axis + 1] = data_axis
+        out[key] = P(*spec)
+    return out
+
+
 def moe_ffn(
     p: dict,
     x: jax.Array,
@@ -753,6 +797,8 @@ def layers_apply(
     sp_axis: str | None = None,
     sp_strategy: str = "ring",
     ep_axis: str | None = None,
+    fsdp_axis: str | None = None,
+    fsdp_gather: dict | None = None,
 ) -> jax.Array:
     """Apply a [Llocal, ...]-stacked group of blocks via lax.scan (one
     compiled block body regardless of depth — compiler-friendly).
@@ -760,9 +806,29 @@ def layers_apply(
     only each block's INPUT for the backward pass and recomputes the
     block internals, so activation memory per stage stays O(1) blocks
     (collectives inside the block — psum/all_to_all/ppermute — are
-    replayed too, which XLA handles)."""
+    replayed too, which XLA handles).
+
+    With fsdp_axis set, each leaf named in fsdp_gather arrives sharded
+    over that mesh axis on dim fsdp_gather[key] and is all-gathered
+    JUST IN TIME inside the block body — classic FSDP: at-rest weight
+    memory is 1/dp per chip, only the current block's weights are ever
+    whole, and the gather's transpose is automatically the
+    reduce-scatter the sharded gradients need. The gather sits inside
+    the remat boundary, so cfg.remat re-gathers on the backward pass
+    instead of keeping full weights alive."""
 
     def block(p_one, h):
+        if fsdp_axis is not None and fsdp_gather:
+            p_one = {
+                k: (
+                    lax.all_gather(
+                        v, fsdp_axis, axis=fsdp_gather[k], tiled=True
+                    )
+                    if k in fsdp_gather
+                    else v
+                )
+                for k, v in p_one.items()
+            }
         return block_apply(
             p_one,
             h,

@@ -46,17 +46,22 @@ def test_generate_example_llama_speculative():
     assert "steady decode" in out and "speculative" in out
 
 
-def test_serve_decode_example_checked():
-    out = _run(
-        [
-            "examples/serve_decode.py", "--layers", "2", "--dim", "64",
-            "--heads", "4", "--ffn", "128", "--vocab", "96",
-            "--max-len", "128", "--requests", "4", "--slots", "2",
-            "--prefix", "6", "--check",
-        ]
-    )
+@pytest.mark.parametrize("prefix", [0, 6])
+def test_serve_decode_example_checked(prefix):
+    args = [
+        "examples/serve_decode.py", "--layers", "2", "--dim", "64",
+        "--heads", "4", "--ffn", "128", "--vocab", "96",
+        "--max-len", "128", "--requests", "4", "--slots", "2",
+        "--check",
+    ]
+    if prefix:
+        args += ["--prefix", str(prefix)]
+    out = _run(args)
     assert "valid greedy choices" in out
-    assert "prefill tokens reused" in out
+    if prefix:
+        assert "prefill tokens reused" in out
+    else:
+        assert "prefill tokens reused" not in out
 
 
 def test_pretrained_example_skips_cleanly_offline():
