@@ -58,14 +58,30 @@ def zero1_shardings(
     pstruct = jax.tree_util.tree_structure(params)
     pleaves = jax.tree_util.tree_leaves(params)
 
+    from defer_tpu.parallel.transformer_stack import (
+        first_free_divisible_dim,
+    )
+
+    def _axes_in(spec):
+        out = set()
+        for e in spec:
+            if isinstance(e, tuple):
+                out |= set(e)
+            elif e is not None:
+                out.add(e)
+        return out
+
     def moment_sharding(pleaf, mleaf):
         spec = list(getattr(pleaf.sharding, "spec", P()) or ())
         spec += [None] * (mleaf.ndim - len(spec))
-        if dp > 1:  # no data axis in the mesh -> keep the param layout
-            for i, (dim, ax) in enumerate(zip(mleaf.shape, spec)):
-                if ax is None and dim % dp == 0 and dim >= dp:
-                    spec[i] = data_axis
-                    break
+        # Skip when the mesh has no data axis (nothing to shard over)
+        # or the param is ALREADY data-sharded (FSDP): the moment then
+        # inherits that layout, which is already 1/dp per chip —
+        # adding the axis twice would be an invalid sharding.
+        if dp > 1 and data_axis not in _axes_in(spec):
+            i = first_free_divisible_dim(spec, mleaf.shape, dp)
+            if i is not None:
+                spec[i] = data_axis
         return NamedSharding(mesh, P(*spec))
 
     rep = NamedSharding(mesh, P())

@@ -344,6 +344,22 @@ def stack_specs(
     return p
 
 
+def first_free_divisible_dim(
+    spec, dims, dp: int, *, offset: int = 0
+) -> int | None:
+    """Index (into `dims`) of the first dimension `spec` leaves
+    unsharded and the axis size `dp` divides — THE placement rule
+    shared by FSDP weight sharding (fsdp_plan, offset=1 to skip the
+    stacked layer axis) and ZeRO-1 moment sharding
+    (train.zero1_shardings). None if no dim qualifies."""
+    spec = list(spec)
+    for i, dim in enumerate(dims):
+        ax = spec[i + offset] if i + offset < len(spec) else None
+        if ax is None and dim % dp == 0 and dim >= dp:
+            return i
+    return None
+
+
 def fsdp_plan(
     cfg: TransformerConfig, per_layer_specs: dict, dp: int
 ) -> dict:
@@ -365,13 +381,11 @@ def fsdp_plan(
     )
     plan: dict = {}
     for key, leaf in shapes.items():
-        spec = list(per_layer_specs[key])
-        dims = leaf.shape[1:]  # drop the stacked layer axis
-        spec += [None] * (len(dims) - (len(spec) - 1))
-        for i, dim in enumerate(dims):
-            if spec[i + 1] is None and dim % dp == 0 and dim >= dp:
-                plan[key] = i
-                break
+        axis = first_free_divisible_dim(
+            per_layer_specs[key], leaf.shape[1:], dp, offset=1
+        )
+        if axis is not None:
+            plan[key] = axis
     return plan
 
 

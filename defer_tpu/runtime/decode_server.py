@@ -68,7 +68,12 @@ class DecodeServer:
         *,
         max_batch: int = 4,
         prefix_ids: jax.Array | None = None,
+        on_token: Any = None,
     ):
+        """`on_token(request_id, token_id, done)` — optional streaming
+        callback fired for every generated token as its batched tick
+        resolves (`done=True` on the request's final token). Keep it
+        cheap: it runs on the serving thread between ticks."""
         self.dec = dec
         self.params = params
         self.B = max_batch
@@ -101,6 +106,7 @@ class DecodeServer:
         self.done: dict[int, jax.Array] = {}
         self._next_id = 0
         self.ticks = 0
+        self.on_token = on_token
         self.solo_steps = 0  # what per-request loops would have cost
 
     # -- public API -------------------------------------------------------
@@ -179,6 +185,8 @@ class DecodeServer:
             slot.remaining = steps - 1
             slot.last = first
             slot.toks = [prompt, first]
+            if self.on_token is not None:
+                self.on_token(rid, int(first[0, 0]), slot.remaining == 0)
             if slot.remaining == 0:
                 self._finish(slot)
 
@@ -203,6 +211,9 @@ class DecodeServer:
         cache = {**cache, "pos": jnp.where(mask, cache["pos"], 0)}
         self.cache = cache
         nxt = jnp.argmax(logits[:, -1, :], axis=-1)  # (B,)
+        # One device->host transfer per tick for streaming, not one
+        # blocking int() per slot.
+        host_nxt = np.asarray(nxt) if self.on_token is not None else None
         for i, slot in enumerate(self.slots):
             if slot.req is None:
                 continue
@@ -210,6 +221,10 @@ class DecodeServer:
             slot.last = tok
             slot.toks.append(tok)
             slot.remaining -= 1
+            if self.on_token is not None:
+                self.on_token(
+                    slot.req, int(host_nxt[i]), slot.remaining == 0
+                )
             if slot.remaining == 0:
                 self._finish(slot)
 

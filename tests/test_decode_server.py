@@ -93,6 +93,31 @@ def test_prefix_cached_serving_matches_solo(family):
     assert stats["saved_prefill_tokens"] == P * len(reqs)
 
 
+def test_streaming_callback_matches_outputs():
+    """on_token streams every generated token in order, with done=True
+    exactly once per request, and the streamed sequence equals the
+    generated tail of the final output."""
+    dec = tiny_gpt(64)
+    params = dec.init(jax.random.key(0))
+    reqs = _requests(dec.cfg.vocab_size)[:4]
+    streamed: dict[int, list[int]] = {}
+    finals: list[int] = []
+
+    def on_token(rid, tok, done):
+        streamed.setdefault(rid, []).append(tok)
+        if done:
+            finals.append(rid)
+
+    srv = DecodeServer(dec, params, max_batch=2, on_token=on_token)
+    rids = [srv.submit(p, s) for p, s in reqs]
+    done = srv.run()
+    assert sorted(finals) == sorted(rids) and len(finals) == len(set(finals))
+    for (p, s), rid in zip(reqs, rids):
+        gen = np.asarray(done[rid])[0, p.shape[1]:]
+        assert streamed[rid] == gen.tolist()
+        assert len(streamed[rid]) == s
+
+
 def test_prefix_validation():
     dec = tiny_gpt(32)
     params = dec.init(jax.random.key(0))

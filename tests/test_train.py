@@ -361,6 +361,31 @@ def test_fsdp_with_remat_and_lora(devices):
     assert jnp.isfinite(loss)
 
 
+def test_fsdp_composes_with_zero1(devices):
+    """fsdp=True + zero1=True must not double-apply the data axis:
+    FSDP-sharded params are already 1/dp, so their moments inherit
+    that layout, and leaves FSDP skipped still get ZeRO's sharding."""
+    cfg = _cfg()
+    mesh = make_mesh({"data": 2, "stage": 2}, devices[:4])
+    sb = SpmdBert(mesh, cfg, compute_dtype=jnp.float32, fsdp=True)
+    init_state, step = make_train_step(
+        sb, optax.adam(1e-3), num_classes=4, zero1=True
+    )
+    state = init_state(jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (3, 2, 16), 0, 64)
+    labels = jax.random.randint(jax.random.key(2), (3, 2), 0, 4)
+    for _ in range(2):
+        state, loss = step(state, ids, labels)
+    assert jnp.isfinite(loss)
+    mu = state.opt_state[0].mu
+    # FSDP stack moment: data axis present exactly once (inherited).
+    w1_spec = [e for e in mu["stack"]["w1"].sharding.spec if e is not None]
+    assert w1_spec.count("data") == 1
+    # Replicated embedding: ZeRO-1 still shards its moment.
+    emb_spec = tuple(mu["token_embedding"].sharding.spec)
+    assert "data" in emb_spec
+
+
 def test_fsdp_requires_data_axis(devices):
     mesh = make_mesh({"stage": 2}, devices[:2])
     with pytest.raises(ValueError, match="data"):
