@@ -78,6 +78,25 @@ def truncate_logits(
     return logits
 
 
+#: Host-sync cadence for eos early-stop polling: `finished.all()` is a
+#: blocking device round trip, so the decode loops check it every K
+#: tokens instead of every token — early stop costs at most K-1 wasted
+#: ticks while the loop keeps its host run-ahead.
+EOS_POLL_EVERY = 8
+
+
+def apply_eos(
+    nxt: jax.Array, finished: jax.Array, eos_id: int
+) -> tuple[jax.Array, jax.Array]:
+    """Shared stop-token step for every decode loop (generate, T5):
+    pin already-finished rows to eos_id BEFORE updating the mask, so a
+    pinned row keeps counting as finished and a row finishes ON its
+    first eos emission. Returns (next_tokens [B, 1], finished [B])."""
+    nxt = jnp.where(finished[:, None], eos_id, nxt)
+    finished = finished | (nxt[:, 0] == eos_id)
+    return nxt, finished
+
+
 def sample_token(
     logits_last: jax.Array,
     rng: jax.Array,
@@ -533,6 +552,7 @@ class GptDecoder:
         temperature: float = 0.0,
         top_k: int = 0,
         top_p: float = 1.0,
+        eos_id: int | None = None,
         rng: jax.Array | None = None,
         prefill_chunk: int | None = None,
     ) -> jax.Array:
@@ -540,7 +560,13 @@ class GptDecoder:
         `prompt_ids` [B, T0]; returns [B, T0 + num_steps]. Prefill runs
         the whole prompt in one step (or fixed `prefill_chunk` pieces
         for long prompts — see prefill); each new token reuses the
-        compiled T=1 step with donated cache."""
+        compiled T=1 step with donated cache.
+
+        With `eos_id` set, a sequence that emits it is FINISHED: its
+        remaining positions are pinned to eos_id (the shape contract
+        stays [B, T0 + num_steps]), and the host loop stops early once
+        every sequence has finished — the serving-standard stop-token
+        behavior without any dynamic shapes."""
         cfg = self.cfg
         b, t0 = prompt_ids.shape
         if self.rolling_cache:
@@ -560,17 +586,35 @@ class GptDecoder:
         ids = prompt_ids
         if rng is None:
             rng = jax.random.key(0)
+        finished = jnp.zeros((b,), bool) if eos_id is not None else None
+        steps_done = 0
         for i in range(num_steps):
             nxt, rng = sample_token(
                 last, rng, temperature, top_k=top_k, top_p=top_p
             )
             nxt = nxt[:, None].astype(prompt_ids.dtype)
+            if eos_id is not None:
+                nxt, finished = apply_eos(nxt, finished, eos_id)
             ids = jnp.concatenate([ids, nxt], axis=1)
+            steps_done = i + 1
+            # Poll the (host-syncing) all-finished check only every
+            # EOS_POLL_EVERY tokens to keep host run-ahead.
+            if (
+                eos_id is not None
+                and (i + 1) % EOS_POLL_EVERY == 0
+                and bool(finished.all())
+            ):
+                break
             if i + 1 < num_steps:
                 # The final sampled token needs no forward pass — its
                 # logits would never be used.
                 logits, cache = step(params, cache, nxt)
                 last = logits[:, -1, :]
+        if steps_done < num_steps:
+            pad = jnp.full(
+                (b, num_steps - steps_done), eos_id, prompt_ids.dtype
+            )
+            ids = jnp.concatenate([ids, pad], axis=1)
         return ids
 
     # -- reference (no cache) ---------------------------------------------

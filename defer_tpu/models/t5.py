@@ -40,7 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from defer_tpu.models.gpt import sample_token
+from defer_tpu.models.gpt import EOS_POLL_EVERY, apply_eos, sample_token
 from defer_tpu.ops.attention import multi_head_attention
 from defer_tpu.parallel.transformer_stack import _rms_norm, embed_lookup
 
@@ -665,6 +665,7 @@ class T5:
         temperature: float = 0.0,
         top_k: int = 0,
         top_p: float = 1.0,
+        eos_id: int | None = None,
         rng: jax.Array | None = None,
         enc_mask: jax.Array | None = None,
     ) -> jax.Array:
@@ -687,15 +688,31 @@ class T5:
         if rng is None:
             rng = jax.random.key(0)
         last, cache = self.prefill(params, cache, ids)
+        finished = jnp.zeros((b,), bool) if eos_id is not None else None
+        steps_done = 0
         for i in range(num_steps):
             nxt, rng = sample_token(
                 last, rng, temperature, top_k=top_k, top_p=top_p
             )
             nxt = nxt[:, None].astype(jnp.int32)
+            if eos_id is not None:
+                # Shared stop-token step; shape contract [B, 1 + N]
+                # is kept by padding after an early break.
+                nxt, finished = apply_eos(nxt, finished, eos_id)
             ids = jnp.concatenate([ids, nxt], axis=1)
+            steps_done = i + 1
+            if (
+                eos_id is not None
+                and (i + 1) % EOS_POLL_EVERY == 0
+                and bool(finished.all())
+            ):
+                break
             if i + 1 < num_steps:
                 logits, cache = step(params, cache, nxt)
                 last = logits[:, -1, :]
+        if steps_done < num_steps:
+            pad = jnp.full((b, num_steps - steps_done), eos_id, jnp.int32)
+            ids = jnp.concatenate([ids, pad], axis=1)
         return ids
 
 

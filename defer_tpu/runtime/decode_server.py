@@ -69,11 +69,17 @@ class DecodeServer:
         max_batch: int = 4,
         prefix_ids: jax.Array | None = None,
         on_token: Any = None,
+        eos_id: int | None = None,
     ):
         """`on_token(request_id, token_id, done)` — optional streaming
         callback fired for every generated token as its batched tick
         resolves (`done=True` on the request's final token). Keep it
-        cheap: it runs on the serving thread between ticks."""
+        cheap: it runs on the serving thread between ticks.
+
+        `eos_id` — stop token: a request that emits it finishes
+        immediately (its output ends with the eos) and its slot
+        re-admits the next queued request, so num_steps becomes a
+        budget rather than an exact length."""
         self.dec = dec
         self.params = params
         self.B = max_batch
@@ -107,6 +113,7 @@ class DecodeServer:
         self._next_id = 0
         self.ticks = 0
         self.on_token = on_token
+        self.eos_id = eos_id
         self.solo_steps = 0  # what per-request loops would have cost
 
     # -- public API -------------------------------------------------------
@@ -185,6 +192,8 @@ class DecodeServer:
             slot.remaining = steps - 1
             slot.last = first
             slot.toks = [prompt, first]
+            if self.eos_id is not None and int(first[0, 0]) == self.eos_id:
+                slot.remaining = 0
             if self.on_token is not None:
                 self.on_token(rid, int(first[0, 0]), slot.remaining == 0)
             if slot.remaining == 0:
@@ -211,9 +220,10 @@ class DecodeServer:
         cache = {**cache, "pos": jnp.where(mask, cache["pos"], 0)}
         self.cache = cache
         nxt = jnp.argmax(logits[:, -1, :], axis=-1)  # (B,)
-        # One device->host transfer per tick for streaming, not one
-        # blocking int() per slot.
-        host_nxt = np.asarray(nxt) if self.on_token is not None else None
+        # One device->host transfer per tick for streaming/eos, not
+        # one blocking int() per slot.
+        need_host = self.on_token is not None or self.eos_id is not None
+        host_nxt = np.asarray(nxt) if need_host else None
         for i, slot in enumerate(self.slots):
             if slot.req is None:
                 continue
@@ -221,6 +231,11 @@ class DecodeServer:
             slot.last = tok
             slot.toks.append(tok)
             slot.remaining -= 1
+            if (
+                self.eos_id is not None
+                and int(host_nxt[i]) == self.eos_id
+            ):
+                slot.remaining = 0
             if self.on_token is not None:
                 self.on_token(
                     slot.req, int(host_nxt[i]), slot.remaining == 0
@@ -242,6 +257,7 @@ def serve_greedy(
     *,
     max_batch: int = 4,
     prefix_ids: jax.Array | None = None,
+    eos_id: int | None = None,
 ) -> tuple[list[jax.Array], dict]:
     """One-shot convenience: serve `[(prompt, steps), ...]`, returning
     outputs in submission order plus stats (`ticks` batched decode
@@ -251,7 +267,8 @@ def serve_greedy(
     prompt is the per-request SUFFIX and outputs cover suffix +
     generation (the prefix ids are not repeated in the result)."""
     srv = DecodeServer(
-        dec, params, max_batch=max_batch, prefix_ids=prefix_ids
+        dec, params, max_batch=max_batch, prefix_ids=prefix_ids,
+        eos_id=eos_id,
     )
     rids = [srv.submit(p, s) for p, s in requests]
     done = srv.run()

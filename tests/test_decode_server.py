@@ -93,6 +93,32 @@ def test_prefix_cached_serving_matches_solo(family):
     assert stats["saved_prefill_tokens"] == P * len(reqs)
 
 
+def test_eos_frees_slots_early():
+    """With a stop token, a request finishing early releases its slot
+    (fewer ticks than the full budget) and each output equals the
+    solo eos-stopped decode trimmed at its first eos."""
+    dec = tiny_gpt(64)
+    params = dec.init(jax.random.key(0))
+    reqs = _requests(dec.cfg.vocab_size)[:4]
+    # Choose an eos that actually occurs: the token request 0 emits
+    # at its second step in a free-running decode.
+    free = dec.generate(params, reqs[0][0], reqs[0][1])
+    eos = int(np.asarray(free)[0, reqs[0][0].shape[1] + 1])
+    outs, stats = serve_greedy(
+        dec, params, reqs, max_batch=2, eos_id=eos
+    )
+    stopped_early = False
+    for (p, s), got in zip(reqs, outs):
+        want = np.asarray(dec.generate(params, p, s, eos_id=eos))
+        got = np.asarray(got)
+        assert got.shape[1] <= want.shape[1]
+        np.testing.assert_array_equal(got[0], want[0, : got.shape[1]])
+        if got.shape[1] < want.shape[1]:
+            assert got[0, -1] == eos
+            stopped_early = True
+    assert stopped_early  # the chosen eos fired for at least one req
+
+
 def test_streaming_callback_matches_outputs():
     """on_token streams every generated token in order, with done=True
     exactly once per request, and the streamed sequence equals the

@@ -192,6 +192,28 @@ def test_generate_with_nucleus_sampling():
     assert toks.min() >= 0 and toks.max() < 128
 
 
+def test_generate_stops_at_eos():
+    """eos_id: generation matches the unstopped run up to the first
+    eos emission, pins everything after to eos, keeps the [B, T0+N]
+    shape, and the host loop provably stopped early (same prefix)."""
+    dec = tiny_gpt()
+    params = dec.init(jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(1), (2, 4), 0, 128)
+    free = np.asarray(dec.generate(params, prompt, 10))
+    # Force a stop: use the token row 0 emits at step 3 as "eos".
+    eos = int(free[0, 4 + 3])
+    out = np.asarray(dec.generate(params, prompt, 10, eos_id=eos))
+    assert out.shape == free.shape
+    for b in range(2):
+        gen_free = free[b, 4:]
+        hits = np.where(gen_free == eos)[0]
+        cut = hits[0] if len(hits) else 10 - 1
+        # identical up to and including the first eos (or the end)
+        np.testing.assert_array_equal(out[b, 4 : 4 + cut + 1],
+                                      gen_free[: cut + 1])
+        assert (out[b, 4 + cut :] == eos).all() or len(hits) == 0
+
+
 def test_tp_sharded_decode_matches_single_device(devices):
     """SpmdGptDecoder over model=2: head-sharded caches + Megatron
     projections reproduce the single-device decoder exactly, through

@@ -143,6 +143,26 @@ def test_generate_shapes_and_determinism():
         m.generate(params, enc_ids, m.cfg.max_len)
 
 
+def test_generate_stops_at_eos():
+    """eos_id pins finished rows to eos and keeps the output shape."""
+    m = tiny_t5()
+    params = m.init(jax.random.key(0))
+    enc = jax.random.randint(jax.random.key(1), (2, 6), 1, m.cfg.vocab_size)
+    free = np.asarray(m.generate(params, enc, 8))
+    eos = int(free[0, 1 + 2])  # row 0's third generated token
+    out = np.asarray(m.generate(params, enc, 8, eos_id=eos))
+    assert out.shape == free.shape
+    for b in range(2):
+        gen_free = free[b, 1:]
+        hits = np.where(gen_free == eos)[0]
+        cut = hits[0] if len(hits) else len(gen_free) - 1
+        np.testing.assert_array_equal(
+            out[b, 1 : 1 + cut + 1], gen_free[: cut + 1]
+        )
+        if len(hits):
+            assert (out[b, 1 + cut :] == eos).all()
+
+
 def test_cross_kv_precomputed_once():
     """start_cache materializes per-layer cross K/V from the encoder
     output; the step never touches ck/cv again (so a zeroed-out ck in
