@@ -176,16 +176,10 @@ class SpmdBert:
             )
         self._fsdp_plan: dict = {}
         if self.fsdp:
-            dp = self.mesh.shape.get("data", 1)
-            if dp <= 1:
-                raise ValueError(
-                    "fsdp=True needs a 'data' mesh axis of size > 1 "
-                    "(there is nothing to shard the weights over)"
-                )
-            from defer_tpu.parallel.transformer_stack import fsdp_plan
+            from defer_tpu.parallel.transformer_stack import build_fsdp_plan
 
-            self._fsdp_plan = fsdp_plan(
-                self.cfg, self._per_layer_specs(), dp
+            self._fsdp_plan = build_fsdp_plan(
+                self.cfg, self._per_layer_specs(), self.mesh
             )
 
     def _per_layer_specs(self):

@@ -97,6 +97,59 @@ def apply_eos(
     return nxt, finished
 
 
+def sampled_decode_loop(
+    step,
+    params: dict,
+    cache,
+    last: jax.Array,
+    ids: jax.Array,
+    num_steps: int,
+    *,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    eos_id: int | None = None,
+    rng: jax.Array | None = None,
+) -> jax.Array:
+    """The one host-side decode loop both decoder families drive
+    (GptDecoder.generate, T5.generate): sample from `last`, append to
+    `ids`, feed the compiled `step(params, cache, nxt)` — with the
+    eos machinery (pin finished rows, poll-every-K early break, pad
+    back to the [B, T + num_steps] shape contract) in a single place.
+    The final sampled token needs no forward pass; its logits would
+    never be used."""
+    b = ids.shape[0]
+    dtype = ids.dtype
+    if rng is None:
+        rng = jax.random.key(0)
+    finished = jnp.zeros((b,), bool) if eos_id is not None else None
+    steps_done = 0
+    for i in range(num_steps):
+        nxt, rng = sample_token(
+            last, rng, temperature, top_k=top_k, top_p=top_p
+        )
+        nxt = nxt[:, None].astype(dtype)
+        if eos_id is not None:
+            nxt, finished = apply_eos(nxt, finished, eos_id)
+        ids = jnp.concatenate([ids, nxt], axis=1)
+        steps_done = i + 1
+        # Poll the (host-syncing) all-finished check only every
+        # EOS_POLL_EVERY tokens to keep host run-ahead.
+        if (
+            eos_id is not None
+            and (i + 1) % EOS_POLL_EVERY == 0
+            and bool(finished.all())
+        ):
+            break
+        if i + 1 < num_steps:
+            logits, cache = step(params, cache, nxt)
+            last = logits[:, -1, :]
+    if steps_done < num_steps:
+        pad = jnp.full((b, num_steps - steps_done), eos_id, dtype)
+        ids = jnp.concatenate([ids, pad], axis=1)
+    return ids
+
+
 def sample_token(
     logits_last: jax.Array,
     rng: jax.Array,
@@ -583,39 +636,19 @@ class GptDecoder:
         last, cache = self.prefill(
             params, cache, prompt_ids, chunk=prefill_chunk
         )
-        ids = prompt_ids
-        if rng is None:
-            rng = jax.random.key(0)
-        finished = jnp.zeros((b,), bool) if eos_id is not None else None
-        steps_done = 0
-        for i in range(num_steps):
-            nxt, rng = sample_token(
-                last, rng, temperature, top_k=top_k, top_p=top_p
-            )
-            nxt = nxt[:, None].astype(prompt_ids.dtype)
-            if eos_id is not None:
-                nxt, finished = apply_eos(nxt, finished, eos_id)
-            ids = jnp.concatenate([ids, nxt], axis=1)
-            steps_done = i + 1
-            # Poll the (host-syncing) all-finished check only every
-            # EOS_POLL_EVERY tokens to keep host run-ahead.
-            if (
-                eos_id is not None
-                and (i + 1) % EOS_POLL_EVERY == 0
-                and bool(finished.all())
-            ):
-                break
-            if i + 1 < num_steps:
-                # The final sampled token needs no forward pass — its
-                # logits would never be used.
-                logits, cache = step(params, cache, nxt)
-                last = logits[:, -1, :]
-        if steps_done < num_steps:
-            pad = jnp.full(
-                (b, num_steps - steps_done), eos_id, prompt_ids.dtype
-            )
-            ids = jnp.concatenate([ids, pad], axis=1)
-        return ids
+        return sampled_decode_loop(
+            step,
+            params,
+            cache,
+            last,
+            prompt_ids,
+            num_steps,
+            temperature=temperature,
+            top_k=top_k,
+            top_p=top_p,
+            eos_id=eos_id,
+            rng=rng,
+        )
 
     # -- reference (no cache) ---------------------------------------------
 

@@ -40,7 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from defer_tpu.models.gpt import EOS_POLL_EVERY, apply_eos, sample_token
+from defer_tpu.models.gpt import sample_token, sampled_decode_loop
 from defer_tpu.ops.attention import multi_head_attention
 from defer_tpu.parallel.transformer_stack import _rms_norm, embed_lookup
 
@@ -688,32 +688,19 @@ class T5:
         if rng is None:
             rng = jax.random.key(0)
         last, cache = self.prefill(params, cache, ids)
-        finished = jnp.zeros((b,), bool) if eos_id is not None else None
-        steps_done = 0
-        for i in range(num_steps):
-            nxt, rng = sample_token(
-                last, rng, temperature, top_k=top_k, top_p=top_p
-            )
-            nxt = nxt[:, None].astype(jnp.int32)
-            if eos_id is not None:
-                # Shared stop-token step; shape contract [B, 1 + N]
-                # is kept by padding after an early break.
-                nxt, finished = apply_eos(nxt, finished, eos_id)
-            ids = jnp.concatenate([ids, nxt], axis=1)
-            steps_done = i + 1
-            if (
-                eos_id is not None
-                and (i + 1) % EOS_POLL_EVERY == 0
-                and bool(finished.all())
-            ):
-                break
-            if i + 1 < num_steps:
-                logits, cache = step(params, cache, nxt)
-                last = logits[:, -1, :]
-        if steps_done < num_steps:
-            pad = jnp.full((b, num_steps - steps_done), eos_id, jnp.int32)
-            ids = jnp.concatenate([ids, pad], axis=1)
-        return ids
+        return sampled_decode_loop(
+            step,
+            params,
+            cache,
+            last,
+            ids,
+            num_steps,
+            temperature=temperature,
+            top_k=top_k,
+            top_p=top_p,
+            eos_id=eos_id,
+            rng=rng,
+        )
 
 
 @dataclasses.dataclass

@@ -149,6 +149,9 @@ class SpmdVit:
     patch_size: int = 16
     num_classes: int = 1000
     compute_dtype: Any = jnp.bfloat16
+    # FSDP: shard stack weights over "data" and all-gather just in
+    # time per block — same contract as SpmdBert(fsdp=True).
+    fsdp: bool = False
 
     def __post_init__(self):
         if "stage" not in self.mesh.axis_names:
@@ -171,11 +174,24 @@ class SpmdVit:
             )
         self.grid = self.image_size // self.patch_size
         self.num_tokens = self.grid * self.grid + 1
+        self._fsdp_plan: dict = {}
+        if self.fsdp:
+            from defer_tpu.parallel.transformer_stack import build_fsdp_plan
+
+            self._fsdp_plan = build_fsdp_plan(
+                self.cfg, self._per_layer_specs(), self.mesh
+            )
+
+    def _per_layer_specs(self):
+        return stack_specs(None, self.tp_axis, cfg=self.cfg)
 
     def _stack_param_specs(self):
-        return staged_specs(
-            stack_specs(None, self.tp_axis, cfg=self.cfg), "stage"
-        )
+        per_layer = self._per_layer_specs()
+        if self._fsdp_plan:
+            from defer_tpu.parallel.transformer_stack import fsdp_specs
+
+            per_layer = fsdp_specs(per_layer, self._fsdp_plan, "data")
+        return staged_specs(per_layer, "stage")
 
     def init(self, rng: jax.Array) -> dict:
         from jax.sharding import NamedSharding
@@ -243,7 +259,14 @@ class SpmdVit:
         cfg = self.cfg
 
         def stage_fn(stack_local, x):
-            return layers_apply(stack_local, x, cfg, tp_axis=self.tp_axis)
+            return layers_apply(
+                stack_local,
+                x,
+                cfg,
+                tp_axis=self.tp_axis,
+                fsdp_axis="data" if self._fsdp_plan else None,
+                fsdp_gather=self._fsdp_plan,
+            )
 
         pipe = make_spmd_pipeline(
             self.mesh,

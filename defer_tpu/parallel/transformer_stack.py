@@ -389,6 +389,18 @@ def fsdp_plan(
     return plan
 
 
+def build_fsdp_plan(cfg: TransformerConfig, per_layer_specs: dict, mesh) -> dict:
+    """Shared SpmdBert/SpmdVit fsdp=True setup: validate the mesh has
+    a data axis to shard over, then plan per-leaf placement."""
+    dp = mesh.shape.get("data", 1)
+    if dp <= 1:
+        raise ValueError(
+            "fsdp=True needs a 'data' mesh axis of size > 1 "
+            "(there is nothing to shard the weights over)"
+        )
+    return fsdp_plan(cfg, per_layer_specs, dp)
+
+
 def fsdp_specs(per_layer_specs: dict, plan: dict, data_axis: str) -> dict:
     """Apply an fsdp_plan to per-layer PartitionSpecs: entry
     plan[key]+1 (after the layer axis) becomes the data axis."""

@@ -125,6 +125,25 @@ def test_spmd_vit_inits_with_lora(devices):
     assert out.shape == (2, 2, 5)
 
 
+def test_spmd_vit_fsdp_matches_replicated(devices):
+    """SpmdVit(fsdp=True): weights rest data-sharded, outputs equal
+    the replicated run."""
+    mesh = make_mesh({"data": 2, "stage": 2}, devices[:4])
+    kw = dict(image_size=16, patch_size=4, num_classes=5,
+              compute_dtype=jnp.float32)
+    sv0 = SpmdVit(mesh, _cfg(), **kw)
+    sv1 = SpmdVit(mesh, _cfg(), fsdp=True, **kw)
+    p0 = sv0.init(jax.random.key(0))
+    p1 = sv1.init(jax.random.key(0))
+    assert "data" in tuple(p1["stack"]["w1"].sharding.spec)
+    images = jax.random.normal(jax.random.key(1), (2, 2, 16, 16, 3))
+    o0 = sv0.make_step()(p0, images)
+    o1 = sv1.make_step()(p1, images)
+    np.testing.assert_allclose(
+        np.asarray(o1), np.asarray(o0), rtol=1e-5, atol=1e-5
+    )
+
+
 def test_spmd_vit_validates_config(devices):
     mesh = make_mesh({"stage": 2}, devices[:2])
     import pytest
