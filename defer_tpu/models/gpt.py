@@ -42,11 +42,40 @@ from defer_tpu.parallel.transformer_stack import (
 )
 
 
+def seen_tokens_mask(ids: jax.Array, vocab: int) -> jax.Array:
+    """[B, V] presence mask of `ids` [B, T]. Build it ONCE from the
+    prompt, then mark each emitted token with a single-element scatter
+    — O(B) per step instead of re-scattering the whole growing
+    sequence."""
+    b = ids.shape[0]
+    return (
+        jnp.zeros((b, vocab), bool)
+        .at[jnp.arange(b)[:, None], ids]
+        .set(True)
+    )
+
+
+def repetition_penalty(
+    logits: jax.Array, seen: jax.Array, penalty: float
+) -> jax.Array:
+    """Discourage already-emitted tokens (HF semantics: a positive
+    logit divides by the penalty, a negative one multiplies — both
+    push the score down for penalty > 1). `seen` is a [B, V] presence
+    mask (seen_tokens_mask) or, for one-shot use, a [B, T] id array."""
+    if penalty == 1.0:
+        return logits
+    if seen.dtype != jnp.bool_:
+        seen = seen_tokens_mask(seen, logits.shape[-1])
+    penalized = jnp.where(logits > 0, logits / penalty, logits * penalty)
+    return jnp.where(seen, penalized, logits)
+
+
 def truncate_logits(
     logits: jax.Array,
     *,
     top_k: int = 0,
     top_p: float = 1.0,
+    min_p: float = 0.0,
 ) -> jax.Array:
     """Mask logits outside the sampling support to -inf.
 
@@ -54,13 +83,20 @@ def truncate_logits(
     survive). top_p < 1 keeps the nucleus: tokens whose cumulative
     probability mass, accumulated in descending-probability order,
     is needed to first reach top_p (the top token always survives).
-    Both filters are static-shape (top_k / sort + cumsum), so the
-    policy jits into the decode step without host round trips.
+    min_p > 0 keeps tokens whose probability is at least min_p times
+    the top token's probability — a confidence-scaled floor that
+    adapts to how peaked the distribution is. All filters are
+    static-shape (top_k / sort + cumsum / max), so the policy jits
+    into the decode step without host round trips.
     """
     neg = jnp.finfo(logits.dtype).min
     if top_k and top_k < logits.shape[-1]:
         kth = lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, neg, logits)
+    if min_p > 0.0:
+        probs = jax.nn.softmax(logits, axis=-1)
+        floor = min_p * jnp.max(probs, axis=-1, keepdims=True)
+        logits = jnp.where(probs < floor, neg, logits)
     if top_p < 1.0:
         desc = jnp.sort(logits, axis=-1)[..., ::-1]
         probs = jax.nn.softmax(desc, axis=-1)
@@ -108,6 +144,8 @@ def sampled_decode_loop(
     temperature: float = 0.0,
     top_k: int = 0,
     top_p: float = 1.0,
+    min_p: float = 0.0,
+    rep_penalty: float = 1.0,
     eos_id: int | None = None,
     rng: jax.Array | None = None,
 ) -> jax.Array:
@@ -123,14 +161,23 @@ def sampled_decode_loop(
     if rng is None:
         rng = jax.random.key(0)
     finished = jnp.zeros((b,), bool) if eos_id is not None else None
+    # Presence mask built once from the prompt; each emitted token is
+    # a single-element scatter (not a re-scan of the whole sequence).
+    seen = None
     steps_done = 0
     for i in range(num_steps):
+        if rep_penalty != 1.0:
+            if seen is None:
+                seen = seen_tokens_mask(ids, last.shape[-1])
+            last = repetition_penalty(last, seen, rep_penalty)
         nxt, rng = sample_token(
-            last, rng, temperature, top_k=top_k, top_p=top_p
+            last, rng, temperature, top_k=top_k, top_p=top_p, min_p=min_p
         )
         nxt = nxt[:, None].astype(dtype)
         if eos_id is not None:
             nxt, finished = apply_eos(nxt, finished, eos_id)
+        if seen is not None:
+            seen = seen.at[jnp.arange(b), nxt[:, 0]].set(True)
         ids = jnp.concatenate([ids, nxt], axis=1)
         steps_done = i + 1
         # Poll the (host-syncing) all-finished check only every
@@ -157,15 +204,19 @@ def sample_token(
     *,
     top_k: int = 0,
     top_p: float = 1.0,
+    min_p: float = 0.0,
 ) -> tuple[jax.Array, jax.Array]:
     """One sampling policy for every decode loop (generate, examples):
-    greedy at temperature 0 (top_k/top_p ignored), otherwise
-    categorical over logits/temperature restricted by truncate_logits.
+    greedy at temperature 0 (filters ignored), otherwise categorical
+    over logits/temperature restricted by truncate_logits.
     Returns (token_ids, next_rng)."""
     if temperature > 0:
         rng, sub = jax.random.split(rng)
         logits = truncate_logits(
-            logits_last / temperature, top_k=top_k, top_p=top_p
+            logits_last / temperature,
+            top_k=top_k,
+            top_p=top_p,
+            min_p=min_p,
         )
         tok = jax.random.categorical(sub, logits, axis=-1)
     else:
@@ -605,6 +656,8 @@ class GptDecoder:
         temperature: float = 0.0,
         top_k: int = 0,
         top_p: float = 1.0,
+        min_p: float = 0.0,
+        rep_penalty: float = 1.0,
         eos_id: int | None = None,
         rng: jax.Array | None = None,
         prefill_chunk: int | None = None,
@@ -646,6 +699,8 @@ class GptDecoder:
             temperature=temperature,
             top_k=top_k,
             top_p=top_p,
+            min_p=min_p,
+            rep_penalty=rep_penalty,
             eos_id=eos_id,
             rng=rng,
         )

@@ -40,7 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from defer_tpu.models.gpt import sample_token, sampled_decode_loop
+from defer_tpu.models.gpt import sampled_decode_loop
 from defer_tpu.ops.attention import multi_head_attention
 from defer_tpu.parallel.transformer_stack import _rms_norm, embed_lookup
 
@@ -665,6 +665,8 @@ class T5:
         temperature: float = 0.0,
         top_k: int = 0,
         top_p: float = 1.0,
+        min_p: float = 0.0,
+        rep_penalty: float = 1.0,
         eos_id: int | None = None,
         rng: jax.Array | None = None,
         enc_mask: jax.Array | None = None,
@@ -698,6 +700,8 @@ class T5:
             temperature=temperature,
             top_k=top_k,
             top_p=top_p,
+            min_p=min_p,
+            rep_penalty=rep_penalty,
             eos_id=eos_id,
             rng=rng,
         )

@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""LoRA fine-tuning, end to end: freeze a base model, train adapters
+only, checkpoint them, merge, and serve the merged model.
+
+The full adapter lifecycle on the SPMD machinery:
+
+  1. build a "pretrained" base (random here; swap in a transplanted
+     checkpoint in practice) with a lora_rank > 0 config;
+  2. train ONLY the adapter factors + task head on a synthetic
+     classification task (the optimizer state is adapter-sized, the
+     base tree is never touched) — over a dp x pp mesh, with FSDP
+     optionally sharding the frozen base weights too;
+  3. save the adapter-only tree (what a fine-tune actually ships);
+  4. merge w + scale * a @ b and run the merged, adapter-free model.
+
+    python examples/finetune_lora.py --steps 30 --rank 8 --fsdp
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import argparse
+import tempfile
+import time
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--ffn", type=int, default=128)
+    ap.add_argument("--vocab", type=int, default=256)
+    ap.add_argument("--classes", type=int, default=4)
+    ap.add_argument("--rank", type=int, default=8)
+    ap.add_argument("--alpha", type=float, default=16.0)
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--lr", type=float, default=1e-2)
+    ap.add_argument("--fsdp", action="store_true",
+                    help="shard the frozen base weights over the data "
+                    "axis too (all-gathered per block)")
+    args = ap.parse_args()
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from defer_tpu.models.bert import SpmdBert
+    from defer_tpu.parallel.lora import (
+        combine_lora,
+        make_lora_train_step,
+        merge_lora,
+        split_lora,
+    )
+    from defer_tpu.parallel.mesh import make_mesh
+    from defer_tpu.parallel.transformer_stack import TransformerConfig
+    from defer_tpu.runtime.checkpoint import load_checkpoint, save_checkpoint
+
+    devs = jax.devices()
+    axes = {"data": 2, "stage": 2} if len(devs) >= 4 else {"stage": 1}
+    mesh = make_mesh(axes, devs[: max(1, 2 * axes.get("data", 1))])
+
+    cfg = TransformerConfig(
+        num_layers=args.layers, dim=args.dim, num_heads=args.heads,
+        ffn_dim=args.ffn, vocab_size=args.vocab, max_len=64,
+        lora_rank=args.rank, lora_alpha=args.alpha,
+        lora_targets=("wq", "wv", "w1", "w2"),
+    )
+    sb = SpmdBert(mesh, cfg, compute_dtype=jnp.float32, fsdp=args.fsdp)
+    init_state, train_step = make_lora_train_step(
+        sb, optax.adam(args.lr), num_classes=args.classes
+    )
+    state, base = init_state(jax.random.key(0))
+
+    n_train = sum(
+        x.size for x in jax.tree_util.tree_leaves(state.params)
+    )
+    n_base = sum(x.size for x in jax.tree_util.tree_leaves(base))
+    print(
+        f"trainable {n_train:,} params ({100 * n_train / n_base:.2f}% "
+        f"of the {n_base:,}-param frozen base), mesh={axes}"
+        + (", base FSDP-sharded" if args.fsdp else "")
+    )
+
+    # Synthetic task: class = hash bucket of the first token.
+    mb, b, s = 2, 4, 16
+    ids = jax.random.randint(
+        jax.random.key(1), (mb, b, s), 0, args.vocab
+    )
+    labels = ids[..., 0] % args.classes
+
+    t0 = time.perf_counter()
+    losses = []
+    for _ in range(args.steps):
+        state, loss = train_step(state, base, ids, labels)
+        losses.append(float(loss))
+    dt = time.perf_counter() - t0
+    print(
+        f"{args.steps} adapter steps in {dt:.2f}s: loss "
+        f"{losses[0]:.3f} -> {losses[-1]:.3f}"
+    )
+    assert losses[-1] < losses[0], "fine-tune failed to reduce loss"
+
+    # Ship the adapters: checkpoint only the trainable tree.
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "adapters.ckpt")
+        save_checkpoint(path, state.params)
+        size_kb = os.path.getsize(path) / 1024
+        restored = load_checkpoint(path)
+    print(f"adapter checkpoint: {size_kb:.1f} KiB (base not included)")
+
+    # Merge for serving: adapter-free tree at base-model cost.
+    tuned = combine_lora(base, restored)
+    merged = merge_lora(tuned, cfg)
+    cfg0 = TransformerConfig(
+        num_layers=args.layers, dim=args.dim, num_heads=args.heads,
+        ffn_dim=args.ffn, vocab_size=args.vocab, max_len=64,
+    )
+    sb0 = SpmdBert(mesh, cfg0, compute_dtype=jnp.float32)
+    pooled = sb0.make_step()(
+        {k: v for k, v in merged.items() if not k.startswith("cls_")},
+        ids,
+    )
+    logits = (
+        np.asarray(pooled, np.float32) @ np.asarray(restored["cls_w"])
+        + np.asarray(restored["cls_b"])
+    )
+    acc = float((logits.argmax(-1) == np.asarray(labels)).mean())
+    print(f"merged-model train accuracy: {acc:.2f}")
+    assert acc > 0.5, "merged model lost the fine-tune"
+    print("finetune_lora OK")
+
+
+if __name__ == "__main__":
+    main()

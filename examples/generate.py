@@ -28,6 +28,7 @@ import argparse
 import time
 
 import jax
+import jax.numpy as jnp
 
 from defer_tpu.models.gpt import GptDecoder, SpmdGptDecoder
 from defer_tpu.parallel.mesh import make_mesh
@@ -49,6 +50,8 @@ def main() -> None:
     ap.add_argument("--temperature", type=float, default=0.0)
     ap.add_argument("--top-k", type=int, default=0)
     ap.add_argument("--top-p", type=float, default=1.0)
+    ap.add_argument("--min-p", type=float, default=0.0)
+    ap.add_argument("--rep-penalty", type=float, default=1.0)
     ap.add_argument(
         "--family",
         choices=("gpt", "llama"),
@@ -130,21 +133,36 @@ def main() -> None:
     logits.block_until_ready()
     t_prefill_compile = time.perf_counter() - t0
 
-    from defer_tpu.models.gpt import sample_token
+    from defer_tpu.models.gpt import (
+        repetition_penalty,
+        sample_token,
+        seen_tokens_mask,
+    )
 
     rng = jax.random.key(7)
+    seen = (
+        seen_tokens_mask(prompt, logits.shape[-1])
+        if args.rep_penalty != 1.0
+        else None
+    )
 
-    def pick(logits_last, rng):
+    def pick(logits_last, rng, seen):
+        lg = logits_last[:, -1, :]
+        if seen is not None:
+            lg = repetition_penalty(lg, seen, args.rep_penalty)
         tok, rng = sample_token(
-            logits_last,
+            lg,
             rng,
             args.temperature,
             top_k=args.top_k,
             top_p=args.top_p,
+            min_p=args.min_p,
         )
-        return tok.astype(prompt.dtype), rng
+        if seen is not None:
+            seen = seen.at[jnp.arange(tok.shape[0]), tok].set(True)
+        return tok[:, None].astype(prompt.dtype), rng, seen
 
-    nxt, rng = pick(logits[:, -1:], rng)
+    nxt, rng, seen = pick(logits, rng, seen)
     t0 = time.perf_counter()
     logits, cache = step(params, cache, nxt)
     logits.block_until_ready()
@@ -152,7 +170,7 @@ def main() -> None:
 
     t0 = time.perf_counter()
     for _ in range(args.steps):
-        nxt, rng = pick(logits[:, -1:], rng)
+        nxt, rng, seen = pick(logits, rng, seen)
         logits, cache = step(params, cache, nxt)
     logits.block_until_ready()
     dt = time.perf_counter() - t0

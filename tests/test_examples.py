@@ -64,6 +64,17 @@ def test_serve_decode_example_checked(prefix):
         assert "prefill tokens reused" not in out
 
 
+def test_finetune_lora_example():
+    out = _run(
+        [
+            "examples/finetune_lora.py", "--layers", "2", "--dim", "32",
+            "--heads", "4", "--ffn", "64", "--vocab", "96",
+            "--rank", "4", "--steps", "15",
+        ]
+    )
+    assert "finetune_lora OK" in out
+
+
 def test_pretrained_example_skips_cleanly_offline():
     # No network, no cache, no --weights file: the documented SKIP
     # contract (exit 0, SKIP line) must hold.

@@ -155,6 +155,50 @@ def test_truncate_logits_top_p():
     assert zero[0, 0] == neg and zero[0, 2] == neg
 
 
+def test_min_p_filters_by_confidence():
+    """min_p keeps tokens whose probability clears min_p x the top
+    probability — a peaked distribution keeps few, a flat one many."""
+    from defer_tpu.models.gpt import truncate_logits
+
+    neg = np.finfo(np.float32).min
+    # probs ~ [0.64, 0.23, 0.09, 0.03]: with min_p=0.2 only the top
+    # two clear 0.2 * 0.64 = 0.128.
+    peaked = jnp.log(jnp.array([[20.0, 7.3, 2.7, 1.0]]))
+    out = np.asarray(truncate_logits(peaked, min_p=0.2))
+    assert out[0, 0] > neg / 2 and out[0, 1] > neg / 2
+    assert out[0, 2] == neg and out[0, 3] == neg
+    # A uniform distribution keeps everything at the same min_p.
+    flat = jnp.zeros((1, 4))
+    np.testing.assert_allclose(
+        np.asarray(truncate_logits(flat, min_p=0.2)), np.asarray(flat)
+    )
+
+
+def test_repetition_penalty_discourages_seen_tokens():
+    """HF semantics: seen tokens' positive logits divide by the
+    penalty, negative ones multiply; unseen logits are untouched —
+    and a greedy decode with a high penalty avoids immediate loops."""
+    from defer_tpu.models.gpt import repetition_penalty
+
+    logits = jnp.array([[2.0, -1.0, 3.0, 0.5]])
+    ids = jnp.array([[0, 1]])  # tokens 0 and 1 already emitted
+    out = np.asarray(repetition_penalty(logits, ids, 2.0))
+    np.testing.assert_allclose(out[0], [1.0, -2.0, 3.0, 0.5])
+    # penalty 1.0 is the identity
+    np.testing.assert_allclose(
+        np.asarray(repetition_penalty(logits, ids, 1.0)),
+        np.asarray(logits),
+    )
+
+    dec = tiny_gpt()
+    params = dec.init(jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(1), (1, 4), 0, 128)
+    out = dec.generate(params, prompt, 12, rep_penalty=1e6)
+    gen = np.asarray(out)[0, 4:]
+    # An absurd penalty forbids ever repeating a token.
+    assert len(set(gen.tolist())) == len(gen)
+
+
 def test_sample_token_top_k_restricts_support():
     """Sampling with top_k=2 at high temperature only ever emits the
     two highest-logit ids; top_k=1 is exactly greedy."""
