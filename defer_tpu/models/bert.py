@@ -247,7 +247,11 @@ class SpmdBert:
 
         return cached_step(self, "step", self._build_step)
 
-    def _build_step(self):
+    def _embed_and_pipe(self):
+        """The shared forward core: token (+learned position) embed ->
+        pipelined stack, (params, ids [M, B, S]) -> [M, B, S, D].
+        Both public steps (pooled and hidden) are tails on this ONE
+        construction, so the stage wiring cannot drift between them."""
         cfg = self.cfg
         cd = self.compute_dtype
 
@@ -273,13 +277,21 @@ class SpmdBert:
             seq_axis=self.sp_axis,
         )
 
-        def step(params, ids):
+        def hidden(params, ids):
             seq = ids.shape[-1]
             emb = jnp.take(params["token_embedding"], ids, axis=0)
             if cfg.pos_style == "learned":
                 emb = emb + params["pos_embedding"][:seq]
-            xs = emb.astype(cd)  # [M, B, S, D]
-            ys = pipe(params["stack"], xs)  # [M, B, S, D]
+            return pipe(params["stack"], emb.astype(cd))
+
+        return hidden
+
+    def _build_step(self):
+        cd = self.compute_dtype
+        hidden = self._embed_and_pipe()
+
+        def step(params, ids):
+            ys = hidden(params, ids)  # [M, B, S, D]
             cls = ys[:, :, 0, :]
             return jnp.tanh(
                 cls @ params["pooler_w"].astype(cd)
@@ -287,6 +299,17 @@ class SpmdBert:
             )
 
         return jax.jit(step)
+
+    def make_hidden_step(self):
+        """Jitted (params, ids [M, B, S]) -> per-position hidden states
+        [M, B, S, D] (no pooler) — the forward a next-token LM head
+        needs (parallel/train.py::make_lm_train_step). Memoized."""
+        from defer_tpu.utils.memo import cached_step
+
+        return cached_step(self, "hidden", self._build_hidden_step)
+
+    def _build_hidden_step(self):
+        return jax.jit(self._embed_and_pipe())
 
     def reference_apply(self, params: dict, ids: jax.Array) -> jax.Array:
         """Unpipelined single-program reference for correctness checks."""

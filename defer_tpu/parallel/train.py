@@ -125,6 +125,40 @@ def make_classifier_params(
     }
 
 
+def _place_zero1(opt_state, params, mesh, zero1: bool, cell: list):
+    """Shared init-side ZeRO-1 placement: device_put the moments with
+    zero1_shardings and stash the sharding tree in `cell` for the
+    step-side constraint."""
+    if not zero1:
+        return opt_state
+    sh = zero1_shardings(opt_state, params, mesh)
+    cell[:] = [sh]
+    return jax.device_put(opt_state, sh)
+
+
+def _make_update_step(optimizer, loss_fn, zero1: bool, opt_shardings: list):
+    """The one donated train-step body both factories share:
+    value_and_grad over loss_fn(params, *batch), optimizer update,
+    ZeRO-1 re-constraint (without it XLA may resolve the elementwise
+    moment update to the replicated gradient layout and silently give
+    the memory saving back), apply."""
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def train_step(state: TrainState, *batch):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, *batch)
+        updates, opt_state = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        if zero1 and opt_shardings:
+            opt_state = jax.lax.with_sharding_constraint(
+                opt_state, opt_shardings[0]
+            )
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    return train_step
+
+
 def make_train_step(
     sb: SpmdBert,
     optimizer: optax.GradientTransformation,
@@ -173,34 +207,111 @@ def make_train_step(
         )
         if extra_params:
             params.update(extra_params)
-        opt_state = optimizer.init(params)
-        if zero1:
-            sh = zero1_shardings(opt_state, params, sb.mesh)
-            opt_state = jax.device_put(opt_state, sh)
-            opt_shardings[:] = [sh]
+        opt_state = _place_zero1(
+            optimizer.init(params), params, sb.mesh, zero1, opt_shardings
+        )
         return TrainState(
             params=params,
             opt_state=opt_state,
             step=jnp.zeros((), jnp.int32),
         )
 
-    # Donating the incoming state lets XLA alias the old params/opt-state
-    # buffers for the updated ones, halving peak HBM for the train state.
-    @partial(jax.jit, donate_argnums=(0,))
-    def train_step(state: TrainState, ids, labels):
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, ids, labels)
-        updates, opt_state = optimizer.update(
-            grads, state.opt_state, state.params
-        )
-        if zero1 and opt_shardings:
-            # Pin the updated moments to the ZeRO layout — without the
-            # constraint XLA may resolve the elementwise update to the
-            # (replicated) gradient layout and silently give the
-            # memory saving back.
-            opt_state = jax.lax.with_sharding_constraint(
-                opt_state, opt_shardings[0]
-            )
-        params = optax.apply_updates(state.params, updates)
-        return TrainState(params, opt_state, state.step + 1), loss
+    return init_state, _make_update_step(
+        optimizer, loss_fn, zero1, opt_shardings
+    )
 
-    return init_state, train_step
+
+def make_lm_train_step(
+    sb: SpmdBert,
+    optimizer: optax.GradientTransformation,
+    *,
+    zero1: bool = False,
+) -> tuple[
+    Callable[[jax.Array], TrainState],
+    Callable[[TrainState, jax.Array], tuple[TrainState, jax.Array]],
+]:
+    """Next-token language-model training through the SPMD pipeline.
+
+    train_step(state, ids [M, B, S]) -> (state, loss): per-position
+    hidden states flow through the pipelined forward
+    (SpmdBert.make_hidden_step), a final norm + WEIGHT-TIED head
+    (token_embedding.T — the GptDecoder convention) produce [.., S, V]
+    logits, and the loss is shifted cross-entropy (position t predicts
+    token t+1, mean over the first S-1 positions).
+
+    The trained tree uses GptDecoder's key set (token_embedding /
+    pos_embedding / final_ln_* / stack), so after flattening the
+    stage-stacked stack ([Stages, L/S, ...] -> [L, ...]) the SAME
+    params serve on the KV-cache decoder — train on the pipeline,
+    serve with the cache.
+
+    Requires cfg.causal=True: a bidirectional stack under a next-token
+    loss would read the answer through attention and "converge"
+    instantly without learning anything.
+    """
+    if not sb.cfg.causal:
+        raise ValueError(
+            "make_lm_train_step needs cfg.causal=True — a "
+            "bidirectional stack leaks each next token to the "
+            "position predicting it"
+        )
+    if sb.cfg.norm_style != "pre":
+        raise ValueError(
+            "make_lm_train_step needs cfg.norm_style='pre': the final "
+            "norm + weight-tied head follow GptDecoder's pre-LN "
+            "convention, and a post-norm tree could not serve on the "
+            "KV-cache decoder afterwards"
+        )
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from defer_tpu.parallel.transformer_stack import _layer_norm, _rms_norm
+
+    forward = sb.make_hidden_step()
+    cfg = sb.cfg
+    opt_shardings: list = []
+
+    def loss_fn(params, ids):
+        h = forward(params, ids).astype(jnp.float32)  # [M, B, S, D]
+        if cfg.norm_type == "rms":
+            h = _rms_norm(h, params["final_ln_scale"], cfg.layer_norm_eps)
+        else:
+            h = _layer_norm(
+                h,
+                params["final_ln_scale"],
+                params["final_ln_bias"],
+                cfg.layer_norm_eps,
+            )
+        logits = h @ params["token_embedding"].astype(jnp.float32).T
+        losses = optax.softmax_cross_entropy_with_integer_labels(
+            logits[..., :-1, :], ids[..., 1:]
+        )
+        return losses.mean()
+
+    def init_state(rng: jax.Array) -> TrainState:
+        base = sb.init(rng)
+        rep = NamedSharding(sb.mesh, P())
+        params = {
+            k: v
+            for k, v in base.items()
+            if k not in ("pooler_w", "pooler_b")
+        }
+        params["final_ln_scale"] = jax.device_put(
+            jnp.ones((cfg.dim,)), rep
+        )
+        if cfg.norm_type == "layer":
+            params["final_ln_bias"] = jax.device_put(
+                jnp.zeros((cfg.dim,)), rep
+            )
+        opt_state = _place_zero1(
+            optimizer.init(params), params, sb.mesh, zero1, opt_shardings
+        )
+        return TrainState(
+            params=params,
+            opt_state=opt_state,
+            step=jnp.zeros((), jnp.int32),
+        )
+
+    return init_state, _make_update_step(
+        optimizer, loss_fn, zero1, opt_shardings
+    )

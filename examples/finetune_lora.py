@@ -58,7 +58,6 @@ def main() -> None:
         combine_lora,
         make_lora_train_step,
         merge_lora,
-        split_lora,
     )
     from defer_tpu.parallel.mesh import make_mesh
     from defer_tpu.parallel.transformer_stack import TransformerConfig

@@ -55,6 +55,24 @@ def main() -> None:
         help="rematerialize blocks on backward (jax.checkpoint): "
         "O(1)-block activation memory per stage for one extra forward",
     )
+    ap.add_argument(
+        "--lm",
+        action="store_true",
+        help="next-token language-model objective (causal stack, "
+        "weight-tied head) instead of CLS classification; the trained "
+        "tree serves directly on the KV-cache decoder",
+    )
+    ap.add_argument(
+        "--zero1",
+        action="store_true",
+        help="shard optimizer moments over the data axis (ZeRO-1)",
+    )
+    ap.add_argument(
+        "--fsdp",
+        action="store_true",
+        help="shard stack weights over the data axis, all-gathered "
+        "just in time per block (FSDP)",
+    )
     args = ap.parse_args()
 
     n_dev = len(jax.devices())
@@ -71,11 +89,20 @@ def main() -> None:
         vocab_size=1024,
         max_len=args.seq,
         remat=args.remat,
+        norm_style="pre" if args.lm else "post",
+        causal=args.lm,
     )
-    sb = SpmdBert(mesh, cfg)
-    init_state, train_step = make_train_step(
-        sb, optax.adamw(1e-3), num_classes=8
-    )
+    sb = SpmdBert(mesh, cfg, fsdp=args.fsdp)
+    if args.lm:
+        from defer_tpu.parallel.train import make_lm_train_step
+
+        init_state, train_step = make_lm_train_step(
+            sb, optax.adamw(1e-3), zero1=args.zero1
+        )
+    else:
+        init_state, train_step = make_train_step(
+            sb, optax.adamw(1e-3), num_classes=8, zero1=args.zero1
+        )
     state = init_state(jax.random.key(0))
 
     import glob
@@ -98,8 +125,11 @@ def main() -> None:
     for step in range(args.steps):
         key, k1, k2 = jax.random.split(key, 3)
         ids = jax.random.randint(k1, (num_mb, batch, args.seq), 0, cfg.vocab_size)
-        labels = jax.random.randint(k2, (num_mb, batch), 0, 8)
-        state, loss = train_step(state, ids, labels)
+        if args.lm:
+            state, loss = train_step(state, ids)
+        else:
+            labels = jax.random.randint(k2, (num_mb, batch), 0, 8)
+            state, loss = train_step(state, ids, labels)
         if step in (0, args.steps - 1) or step % 10 == 0:
             print(f"step {step}: loss {float(loss):.4f}")
         if args.ckpt_dir and (step + 1) % args.ckpt_every == 0:

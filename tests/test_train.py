@@ -260,6 +260,92 @@ def test_moe_top_k_validated():
         _cfg(num_experts=2, moe_top_k=3)
 
 
+def test_lm_train_then_serve_on_decoder(devices):
+    """Next-token LM training through the pipeline, then the SAME
+    trained tree (stack flattened from [Stages, L/S, ...] to [L, ...])
+    serves on the KV-cache decoder: the decoder's full-sequence logits
+    assign the training corpus a much better loss than at init, and
+    pipeline-side logits equal decoder-side logits."""
+    import optax as _optax
+
+    from defer_tpu.models.gpt import GptDecoder
+    from defer_tpu.parallel.train import make_lm_train_step
+    from defer_tpu.parallel.transformer_stack import _layer_norm
+
+    cfg = TransformerConfig(
+        num_layers=4, dim=32, num_heads=4, ffn_dim=64,
+        vocab_size=64, max_len=16, norm_style="pre", causal=True,
+    )
+    mesh = make_mesh({"data": 2, "stage": 2}, devices[:4])
+    sb = SpmdBert(mesh, cfg, compute_dtype=jnp.float32)
+    init_state, step = make_lm_train_step(sb, _optax.adam(5e-3))
+    state = init_state(jax.random.key(0))
+    # One fixed corpus, memorized.
+    ids = jax.random.randint(jax.random.key(1), (2, 4, 12), 0, 64)
+    losses = []
+    for _ in range(30):
+        state, loss = step(state, ids)
+        losses.append(float(loss))
+    assert losses[-1] < 0.5 * losses[0], losses
+
+    def decoder_loss(dparams):
+        dec = GptDecoder(cfg, compute_dtype=jnp.float32)
+        flat_ids = np.asarray(ids).reshape(-1, 12)
+        logits = dec.reference_logits(dparams, jnp.asarray(flat_ids))
+        import optax
+
+        return float(
+            optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1, :], jnp.asarray(flat_ids)[:, 1:]
+            ).mean()
+        )
+
+    def flatten(tree):
+        out = {k: v for k, v in tree.items() if k != "stack"}
+        out["stack"] = jax.tree_util.tree_map(
+            lambda a: jnp.asarray(a).reshape(-1, *a.shape[2:]),
+            tree["stack"],
+        )
+        return out
+
+    trained = decoder_loss(flatten(state.params))
+    fresh = decoder_loss(flatten(init_state(jax.random.key(0)).params))
+    assert trained < 0.5 * fresh, (trained, fresh)
+    # Train/serve logits parity at one position.
+    dec = GptDecoder(cfg, compute_dtype=jnp.float32)
+    dparams = flatten(state.params)
+    want = dec.reference_logits(dparams, ids[0])[:, -1, :]
+    h = sb.make_hidden_step()(state.params, ids)[0].astype(jnp.float32)
+    h = _layer_norm(
+        h,
+        state.params["final_ln_scale"],
+        state.params["final_ln_bias"],
+        cfg.layer_norm_eps,
+    )
+    got = (h @ state.params["token_embedding"].T)[:, -1, :]
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_lm_train_requires_causal(devices):
+    from defer_tpu.parallel.train import make_lm_train_step
+
+    mesh = make_mesh({"stage": 2}, devices[:2])
+    sb = SpmdBert(
+        mesh, _cfg(norm_style="pre"), compute_dtype=jnp.float32
+    )
+    with pytest.raises(ValueError, match="causal"):
+        make_lm_train_step(sb, optax.adam(1e-3))
+    # Post-norm causal trains fine as a classifier but cannot serve on
+    # the pre-LN decoder — reject before the training run, not after.
+    sb_post = SpmdBert(
+        mesh, _cfg(causal=True), compute_dtype=jnp.float32
+    )
+    with pytest.raises(ValueError, match="pre"):
+        make_lm_train_step(sb_post, optax.adam(1e-3))
+
+
 def test_zero1_matches_replicated_and_shards_moments(devices):
     """ZeRO-1 is a layout change, not a numerics change: losses match
     the replicated-optimizer run step for step, and the Adam moments
