@@ -266,8 +266,6 @@ def test_lm_train_then_serve_on_decoder(devices):
     serves on the KV-cache decoder: the decoder's full-sequence logits
     assign the training corpus a much better loss than at init, and
     pipeline-side logits equal decoder-side logits."""
-    import optax as _optax
-
     from defer_tpu.models.gpt import GptDecoder
     from defer_tpu.parallel.train import make_lm_train_step
     from defer_tpu.parallel.transformer_stack import _layer_norm
@@ -278,7 +276,7 @@ def test_lm_train_then_serve_on_decoder(devices):
     )
     mesh = make_mesh({"data": 2, "stage": 2}, devices[:4])
     sb = SpmdBert(mesh, cfg, compute_dtype=jnp.float32)
-    init_state, step = make_lm_train_step(sb, _optax.adam(5e-3))
+    init_state, step = make_lm_train_step(sb, optax.adam(5e-3))
     state = init_state(jax.random.key(0))
     # One fixed corpus, memorized.
     ids = jax.random.randint(jax.random.key(1), (2, 4, 12), 0, 64)
@@ -292,8 +290,6 @@ def test_lm_train_then_serve_on_decoder(devices):
         dec = GptDecoder(cfg, compute_dtype=jnp.float32)
         flat_ids = np.asarray(ids).reshape(-1, 12)
         logits = dec.reference_logits(dparams, jnp.asarray(flat_ids))
-        import optax
-
         return float(
             optax.softmax_cross_entropy_with_integer_labels(
                 logits[:, :-1, :], jnp.asarray(flat_ids)[:, 1:]
