@@ -489,32 +489,11 @@ class GptDecoder:
         paths; the tp variant adds psum inside _block, Megatron vocab
         sharding around the embedding/tied head, and a shard_map
         wrapper around this."""
-        cfg = self.cfg
-        cd = self.compute_dtype
 
         def step(params, cache, ids):
-            from defer_tpu.models.quant import dequantize_leaf
-
-            b, t = ids.shape
+            t = ids.shape[1]
             pos = cache["pos"]
-            emb = embed_lookup(params["token_embedding"], ids, tp_axis)
-            if cfg.pos_style == "rope":
-                # Rotary positions enter inside each block's q/k.
-                x = emb.astype(cd)
-            elif getattr(pos, "ndim", 0) == 1:
-                # Per-slot depths (continuous batching): gather each
-                # element's own position rows.
-                posv = jnp.take(
-                    params["pos_embedding"],
-                    pos[:, None] + jnp.arange(t),
-                    axis=0,
-                )
-                x = (emb + posv).astype(cd)
-            else:
-                posv = lax.dynamic_slice_in_dim(
-                    params["pos_embedding"], pos, t, axis=0
-                )
-                x = (emb + posv).astype(cd)
+            x = self._embed_tokens(params, ids, pos, tp_axis)
 
             def body(carry, layer):
                 x = carry
@@ -525,31 +504,58 @@ class GptDecoder:
             x, (new_k, new_v) = lax.scan(
                 body, x, (params["stack"], cache["k"], cache["v"])
             )
-            xf = x.astype(jnp.float32)
-            if cfg.norm_type == "rms":
-                x = _rms_norm(
-                    xf, params["final_ln_scale"], cfg.layer_norm_eps
-                )
-            else:
-                x = _layer_norm(
-                    xf,
-                    params["final_ln_scale"],
-                    params["final_ln_bias"],
-                    cfg.layer_norm_eps,
-                )
-            # Output head, fp32: tied to the embedding unless the
-            # checkpoint shipped a distinct lm_head (untied llama
-            # releases). Under tp each shard produces its vocab slice
-            # [B, T, Vpad/tp]; the caller's out_specs concatenate the
-            # slices into the global logits (no in-body collective,
-            # and shard_map's replication checking stays on).
-            head = params.get("lm_head", params["token_embedding"])
-            head = dequantize_leaf(head, jnp.float32)
-            logits = x @ head.T
+            logits = self._final_logits(params, x)
             new_cache = {"k": new_k, "v": new_v, "pos": pos + t}
             return logits, new_cache
 
         return step
+
+    def _embed_tokens(self, params, ids, pos, tp_axis=None):
+        """Token (+learned position) embedding for a step at write
+        head `pos` (scalar, or (B,) per-slot depths — continuous
+        batching gathers each element's own position rows)."""
+        cfg = self.cfg
+        cd = self.compute_dtype
+        t = ids.shape[1]
+        emb = embed_lookup(params["token_embedding"], ids, tp_axis)
+        if cfg.pos_style == "rope":
+            # Rotary positions enter inside each block's q/k.
+            return emb.astype(cd)
+        if getattr(pos, "ndim", 0) == 1:
+            posv = jnp.take(
+                params["pos_embedding"],
+                pos[:, None] + jnp.arange(t),
+                axis=0,
+            )
+            return (emb + posv).astype(cd)
+        posv = lax.dynamic_slice_in_dim(
+            params["pos_embedding"], pos, t, axis=0
+        )
+        return (emb + posv).astype(cd)
+
+    def _final_logits(self, params, x):
+        """Final norm + output head, fp32: tied to the embedding
+        unless the checkpoint shipped a distinct lm_head (untied llama
+        releases). Under tp each shard produces its vocab slice
+        [B, T, Vpad/tp]; the caller's out_specs concatenate the slices
+        into the global logits (no in-body collective, and shard_map's
+        replication checking stays on)."""
+        from defer_tpu.models.quant import dequantize_leaf
+
+        cfg = self.cfg
+        xf = x.astype(jnp.float32)
+        if cfg.norm_type == "rms":
+            xn = _rms_norm(xf, params["final_ln_scale"], cfg.layer_norm_eps)
+        else:
+            xn = _layer_norm(
+                xf,
+                params["final_ln_scale"],
+                params["final_ln_bias"],
+                cfg.layer_norm_eps,
+            )
+        head = params.get("lm_head", params["token_embedding"])
+        head = dequantize_leaf(head, jnp.float32)
+        return xn @ head.T
 
     def _memo_key(self, donate: bool):
         """Memo key for make_step; subclasses extend it when the

@@ -1,0 +1,360 @@
+"""Paged KV cache: a shared block pool instead of per-slot max_len
+lanes (the vLLM idea, TPU-shaped).
+
+A contiguous continuous-batching cache (runtime/decode_server.py)
+reserves `max_batch x max_len` K/V rows even when every request is
+short — decode HBM is cache-bound, so reserved-but-unused rows are the
+serving memory ceiling. Here the cache is a pool of fixed-size BLOCKS
+([L, num_blocks, H_kv, block_size, Dh]); each slot holds a BLOCK TABLE
+of pool indices, and memory scales with the sum of actual request
+budgets, not slots x max_len.
+
+Static-shape design (everything jits once):
+
+  * the decode step gathers each slot's blocks into the standard
+    contiguous [B, H_kv, S, Dh] view (one gather per layer) and runs
+    the EXACT SAME block math as the flat decoder (GptDecoder._block)
+    — numerical parity is inherited, not re-proven — then scatters the
+    single new K/V row back to its block;
+  * block tables are a fixed [B, max_blocks] shape; unallocated
+    entries point at the reserved TRASH block 0 (never allocated to a
+    request), so out-of-budget writes land in scrap instead of another
+    request's memory and garbage reads sit beyond the position mask;
+  * allocation is host-side and exact: a request's block need is known
+    at submit time (prompt + step budget, eos can only shorten it), so
+    admission takes ceil(total/block_size) blocks from the free list
+    and finishing returns them — when the pool is exhausted, requests
+    simply wait (the pool, not the slot count, is the admission
+    limit).
+
+Prefill reuses the flat decoder's admission path (single-request
+contiguous prefill), and the resulting rows are scattered into the
+allocated blocks in one jitted op.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+class PagedDecodeServer:
+    """Greedy continuous batching over a paged KV pool.
+
+    Protocol-compatible with runtime/decode_server.DecodeServer
+    (submit -> run -> {rid: ids}), with the pool replacing per-slot
+    max_len lanes. `num_blocks` INCLUDES the reserved trash block 0.
+    """
+
+    def __init__(
+        self,
+        dec: Any,
+        params: dict,
+        *,
+        num_blocks: int,
+        block_size: int = 16,
+        max_batch: int = 4,
+        eos_id: int | None = None,
+    ):
+        if getattr(dec, "rolling_cache", False):
+            raise ValueError("paged serving does not support rolling caches")
+        if block_size < 1 or num_blocks < 2:
+            raise ValueError(
+                f"need block_size >= 1 and num_blocks >= 2 (one trash "
+                f"block + one usable), got {block_size}/{num_blocks}"
+            )
+        self.dec = dec
+        self.params = params
+        self.B = max_batch
+        self.bs = block_size
+        self.eos_id = eos_id
+        cfg = dec.cfg
+        # Max logical blocks any sequence can span.
+        self.MB = -(-cfg.max_len // block_size)
+        dh = cfg.dim // cfg.num_heads
+        pool_shape = (
+            cfg.num_layers, num_blocks, cfg.kv_heads, block_size, dh,
+        )
+        self.pool_k = jnp.zeros(pool_shape, dec.compute_dtype)
+        self.pool_v = jnp.zeros(pool_shape, dec.compute_dtype)
+        # Block 0 is trash: unallocated table entries point at it.
+        self.free = list(range(1, num_blocks))
+        self.tables = np.zeros((max_batch, self.MB), np.int32)
+        self.pos = np.zeros((max_batch,), np.int32)
+        self.slots: list[dict | None] = [None] * max_batch
+        self.pending: list[tuple[int, jax.Array, int]] = []
+        self.done: dict[int, jax.Array] = {}
+        self._next_id = 0
+        self.ticks = 0
+        self._step = None
+        self._insert = None
+
+    # -- public API -------------------------------------------------------
+
+    def submit(self, prompt_ids: jax.Array, num_steps: int) -> int:
+        if prompt_ids.ndim != 2 or prompt_ids.shape[0] != 1:
+            raise ValueError("submit one request at a time ([1, T])")
+        t0 = prompt_ids.shape[1]
+        if t0 < 1 or num_steps < 1:
+            raise ValueError("need at least 1 prompt token and 1 step")
+        if t0 + num_steps > self.dec.cfg.max_len:
+            raise ValueError(
+                f"prompt {t0} + steps {num_steps} exceeds max_len "
+                f"{self.dec.cfg.max_len}"
+            )
+        need = -(-(t0 + num_steps) // self.bs)
+        if need > self.pool_k.shape[1] - 1:
+            # Not even an empty pool could hold it — waiting would
+            # deadlock the queue.
+            raise ValueError(
+                f"request needs {need} blocks but the pool has "
+                f"{self.pool_k.shape[1] - 1} usable"
+            )
+        rid = self._next_id
+        self._next_id += 1
+        self.pending.append((rid, prompt_ids, num_steps))
+        return rid
+
+    def run(self) -> dict[int, jax.Array]:
+        while self.pending or any(self.slots):
+            self._admit()
+            self._tick()
+        return self.done
+
+    @property
+    def blocks_in_use(self) -> int:
+        return sum(len(s["blocks"]) for s in self.slots if s)
+
+    # -- internals --------------------------------------------------------
+
+    def _build(self):
+        if self._step is not None:
+            return
+        dec, cfg, bs = self.dec, self.dec.cfg, self.bs
+
+        def step(params, pk, pv, tables, pos, ids):
+            b = ids.shape[0]
+            x = dec._embed_tokens(params, ids, pos)
+            rows = jnp.arange(b)
+
+            def body(carry, layer):
+                x = carry
+                p, pk_l, pv_l = layer  # [NB, Hkv, bs, Dh]
+                # Gather this slot's pages into the contiguous view
+                # the flat block math expects: [B, Hkv, MB*bs, Dh].
+                kc = pk_l[tables]  # [B, MB, Hkv, bs, Dh]
+                vc = pv_l[tables]
+                b_, mb, hkv, _, dh = kc.shape
+                kc = kc.transpose(0, 2, 1, 3, 4).reshape(
+                    b_, hkv, mb * bs, dh
+                )
+                vc = vc.transpose(0, 2, 1, 3, 4).reshape(
+                    b_, hkv, mb * bs, dh
+                )
+                out, kc, vc = dec._block(p, x, kc, vc, pos)
+                # Scatter ONLY the new row back to its page.
+                blk = tables[rows, pos // bs]  # [B]
+                row = pos % bs
+                new_k = kc[rows, :, pos, :]  # [B, Hkv, Dh]
+                new_v = vc[rows, :, pos, :]
+                pk_l = pk_l.at[blk, :, row, :].set(new_k)
+                pv_l = pv_l.at[blk, :, row, :].set(new_v)
+                return out, (pk_l, pv_l)
+
+            x, (pk, pv) = lax.scan(
+                body, x, (params["stack"], pk, pv)
+            )
+            logits = dec._final_logits(params, x)
+            return logits, pk, pv
+
+        self._step = jax.jit(step, donate_argnums=(1, 2))
+
+        def insert(pk, pv, small_k, small_v, table_row, slot_pool_blocks):
+            """Scatter a contiguous single-request prefill cache
+            ([L, 1, Hkv, S, Dh]) into this request's pool blocks.
+            Rows beyond the prompt are garbage the position mask
+            hides; only OWNED blocks are written (the fixed-shape
+            table_row may point extra entries at trash block 0, which
+            is overwritten harmlessly)."""
+            mb = table_row.shape[0]
+            s_need = mb * bs
+            k_rows = small_k[:, 0]  # [L, Hkv, S, Dh]
+            v_rows = small_v[:, 0]
+            pad = s_need - k_rows.shape[2]
+            if pad > 0:
+                k_rows = jnp.pad(
+                    k_rows, ((0, 0), (0, 0), (0, pad), (0, 0))
+                )
+                v_rows = jnp.pad(
+                    v_rows, ((0, 0), (0, 0), (0, pad), (0, 0))
+                )
+            else:
+                k_rows = k_rows[:, :, :s_need]
+                v_rows = v_rows[:, :, :s_need]
+            L, hkv, _, dh = k_rows.shape
+            k_blocks = k_rows.reshape(L, hkv, mb, bs, dh).transpose(
+                0, 2, 1, 3, 4
+            )  # [L, MB, Hkv, bs, Dh]
+            v_blocks = v_rows.reshape(L, hkv, mb, bs, dh).transpose(
+                0, 2, 1, 3, 4
+            )
+            # Mask writes to blocks this request does not own.
+            owned = slot_pool_blocks >= 0  # [MB]
+            dest = jnp.where(owned, table_row, 0)
+            k_cur = pk[:, dest]  # current contents where not owned
+            v_cur = pv[:, dest]
+            k_w = jnp.where(
+                owned[None, :, None, None, None], k_blocks, k_cur
+            )
+            v_w = jnp.where(
+                owned[None, :, None, None, None], v_blocks, v_cur
+            )
+            pk = pk.at[:, dest].set(k_w)
+            pv = pv.at[:, dest].set(v_w)
+            return pk, pv
+
+        self._insert = jax.jit(insert, donate_argnums=(0, 1))
+
+    def _admit(self) -> None:
+        for i in range(self.B):
+            if self.slots[i] is not None or not self.pending:
+                continue
+            rid, prompt, steps = self.pending[0]
+            t0 = prompt.shape[1]
+            need = -(-(t0 + steps) // self.bs)
+            if need > len(self.free):
+                return  # pool exhausted: wait for a finisher
+            self.pending.pop(0)
+            blocks = [self.free.pop() for _ in range(need)]
+            self._build()
+            # Contiguous prefill through the flat decoder, then page
+            # the rows in.
+            small = self.dec.init_cache(1)
+            logits, small = self.dec.make_step()(
+                self.params, small, prompt
+            )
+            table_row = np.zeros((self.MB,), np.int32)
+            owned = np.full((self.MB,), -1, np.int32)
+            for j, blk in enumerate(blocks):
+                table_row[j] = blk
+                owned[j] = blk
+            self.pool_k, self.pool_v = self._insert(
+                self.pool_k,
+                self.pool_v,
+                small["k"],
+                small["v"],
+                jnp.asarray(table_row),
+                jnp.asarray(owned),
+            )
+            first = jnp.argmax(logits[:, t0 - 1, :], axis=-1)[
+                :, None
+            ].astype(prompt.dtype)
+            self.tables[i] = table_row
+            self.pos[i] = t0
+            slot = {
+                "rid": rid,
+                "remaining": steps - 1,
+                "last": first,
+                "toks": [prompt, first],
+                "blocks": blocks,
+            }
+            self.slots[i] = slot
+            if (
+                self.eos_id is not None
+                and int(first[0, 0]) == self.eos_id
+            ):
+                slot["remaining"] = 0
+            if slot["remaining"] == 0:
+                self._finish(i)
+
+    def _tick(self) -> None:
+        live = [s is not None for s in self.slots]
+        if not any(live):
+            return
+        self._build()
+        feed = jnp.concatenate(
+            [
+                s["last"] if s else jnp.zeros((1, 1), jnp.int32)
+                for s in self.slots
+            ],
+            axis=0,
+        )
+        # Idle slots write into trash block 0 at position 0.
+        pos = jnp.asarray(
+            np.where(live, self.pos, 0).astype(np.int32)
+        )
+        logits, self.pool_k, self.pool_v = self._step(
+            self.params,
+            self.pool_k,
+            self.pool_v,
+            jnp.asarray(self.tables),
+            pos,
+            feed,
+        )
+        self.ticks += 1
+        nxt = jnp.argmax(logits[:, -1, :], axis=-1)
+        host_nxt = np.asarray(nxt)
+        for i, slot in enumerate(self.slots):
+            if slot is None:
+                continue
+            tok = nxt[i][None, None].astype(slot["last"].dtype)
+            slot["last"] = tok
+            slot["toks"].append(tok)
+            slot["remaining"] -= 1
+            self.pos[i] += 1
+            if (
+                self.eos_id is not None
+                and int(host_nxt[i]) == self.eos_id
+            ):
+                slot["remaining"] = 0
+            if slot["remaining"] == 0:
+                self._finish(i)
+
+    def _finish(self, i: int) -> None:
+        slot = self.slots[i]
+        self.done[slot["rid"]] = jnp.concatenate(slot["toks"], axis=1)
+        self.free.extend(slot["blocks"])
+        self.tables[i] = 0
+        self.pos[i] = 0
+        self.slots[i] = None
+
+
+def serve_paged(
+    dec: Any,
+    params: dict,
+    requests: list[tuple[jax.Array, int]],
+    *,
+    num_blocks: int,
+    block_size: int = 16,
+    max_batch: int = 4,
+    eos_id: int | None = None,
+) -> tuple[list[jax.Array], dict]:
+    """One-shot paged serving; returns (outputs in submission order,
+    stats incl. peak pool usage)."""
+    srv = PagedDecodeServer(
+        dec,
+        params,
+        num_blocks=num_blocks,
+        block_size=block_size,
+        max_batch=max_batch,
+        eos_id=eos_id,
+    )
+    rids = [srv.submit(p, s) for p, s in requests]
+    peak = 0
+    while srv.pending or any(srv.slots):
+        srv._admit()
+        peak = max(peak, srv.blocks_in_use)
+        srv._tick()
+    done = srv.done
+    stats = {
+        "ticks": srv.ticks,
+        "peak_blocks": peak,
+        "pool_blocks": int(srv.pool_k.shape[1]) - 1,
+        "block_size": block_size,
+        "flat_equivalent_rows": max_batch * dec.cfg.max_len,
+    }
+    return [done[r] for r in rids], stats
