@@ -443,6 +443,70 @@ def bench_decode_server(devices) -> dict:
     return rec
 
 
+def bench_paged_server(devices) -> dict:
+    """Paged-KV serving (runtime/paged.py): the decode-server workload
+    through a block pool at a fraction of the flat-lane rows — the
+    serving-memory headline (cache rows scale with request budgets,
+    not slots x max_len) with throughput recorded alongside."""
+    import jax
+    import jax.numpy as jnp
+
+    from defer_tpu.models.gpt import GptDecoder
+    from defer_tpu.models.llama import llama_config
+    from defer_tpu.runtime.paged import serve_paged
+
+    cfg = llama_config(
+        num_layers=16,
+        dim=2048,
+        num_heads=16,
+        num_kv_heads=4,
+        ffn_dim=5632,
+        vocab_size=32000,
+        max_len=512,
+    )
+    dec = GptDecoder(cfg, compute_dtype=jnp.bfloat16)
+    params = jax.device_put(
+        dec.cast_params(dec.init(jax.random.key(0))), devices[0]
+    )
+    reqs = []
+    for i in range(8):
+        t0 = 16 + (i * 23) % 112
+        steps = 16 + (i * 11) % 48
+        prompt = jax.random.randint(
+            jax.random.fold_in(jax.random.key(1), i),
+            (1, t0),
+            0,
+            cfg.vocab_size,
+        )
+        reqs.append((prompt, steps))
+
+    def run():
+        t0 = time.perf_counter()
+        outs, stats = serve_paged(
+            dec, params, reqs, num_blocks=49, block_size=16, max_batch=4
+        )
+        jax.block_until_ready(outs[-1])
+        return time.perf_counter() - t0, stats
+
+    run()  # compile pass
+    dt, stats = run()
+    total = sum(s for _, s in reqs)
+    pool_rows = stats["pool_blocks"] * stats["block_size"]
+    rec = {
+        "requests": len(reqs),
+        "slots": 4,
+        "tokens_per_sec": round(total / dt, 1),
+        "pool_rows": pool_rows,
+        "flat_rows": stats["flat_equivalent_rows"],
+        "cache_mem_ratio": round(
+            pool_rows / stats["flat_equivalent_rows"], 3
+        ),
+        "peak_blocks": stats["peak_blocks"],
+    }
+    log(f"paged server (llama-1b, block pool): {rec}")
+    return rec
+
+
 def bench_bert(devices) -> dict:
     """Single-chip SPMD BERT-base forward throughput + MFU."""
     import jax
@@ -670,6 +734,7 @@ def run_bench() -> dict:
         "gpt_decode": None,
         "llama_decode": None,
         "decode_server": None,
+        "paged_server": None,
         "pallas_attention": None,
     }
     snapshot(result)
@@ -813,6 +878,7 @@ def run_bench() -> dict:
             ("gpt_decode", bench_gpt_decode),
             ("llama_decode", bench_llama_decode),
             ("decode_server", bench_decode_server),
+            ("paged_server", bench_paged_server),
             ("bert_base", bench_bert),
         ]
         # Mosaic-kernel section last. It runs wherever the pallas gate

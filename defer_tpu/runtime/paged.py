@@ -90,6 +90,7 @@ class PagedDecodeServer:
         self.done: dict[int, jax.Array] = {}
         self._next_id = 0
         self.ticks = 0
+        self.blocks_peak = 0
         self._step = None
         self._insert = None
 
@@ -173,13 +174,14 @@ class PagedDecodeServer:
 
         self._step = jax.jit(step, donate_argnums=(1, 2))
 
-        def insert(pk, pv, small_k, small_v, table_row, slot_pool_blocks):
+        def insert(pk, pv, small_k, small_v, table_row):
             """Scatter a contiguous single-request prefill cache
             ([L, 1, Hkv, S, Dh]) into this request's pool blocks.
             Rows beyond the prompt are garbage the position mask
-            hides; only OWNED blocks are written (the fixed-shape
-            table_row may point extra entries at trash block 0, which
-            is overwritten harmlessly)."""
+            hides; unowned table entries point at trash block 0, so
+            their writes land in scrap by the module invariant (no
+            masking needed — duplicate trash writes just race over
+            garbage)."""
             mb = table_row.shape[0]
             s_need = mb * bs
             k_rows = small_k[:, 0]  # [L, Hkv, S, Dh]
@@ -202,19 +204,8 @@ class PagedDecodeServer:
             v_blocks = v_rows.reshape(L, hkv, mb, bs, dh).transpose(
                 0, 2, 1, 3, 4
             )
-            # Mask writes to blocks this request does not own.
-            owned = slot_pool_blocks >= 0  # [MB]
-            dest = jnp.where(owned, table_row, 0)
-            k_cur = pk[:, dest]  # current contents where not owned
-            v_cur = pv[:, dest]
-            k_w = jnp.where(
-                owned[None, :, None, None, None], k_blocks, k_cur
-            )
-            v_w = jnp.where(
-                owned[None, :, None, None, None], v_blocks, v_cur
-            )
-            pk = pk.at[:, dest].set(k_w)
-            pv = pv.at[:, dest].set(v_w)
+            pk = pk.at[:, table_row].set(k_blocks)
+            pv = pv.at[:, table_row].set(v_blocks)
             return pk, pv
 
         self._insert = jax.jit(insert, donate_argnums=(0, 1))
@@ -231,24 +222,30 @@ class PagedDecodeServer:
             self.pending.pop(0)
             blocks = [self.free.pop() for _ in range(need)]
             self._build()
-            # Contiguous prefill through the flat decoder, then page
-            # the rows in.
+            self.blocks_peak = max(
+                self.blocks_peak, self.blocks_in_use + need
+            )
+            # Contiguous prefill through the flat decoder — pow2
+            # bucketed like the flat server, so the compiled prefill
+            # shape set stays tiny — then page the rows in.
+            pad = 1 << (t0 - 1).bit_length()
+            pad = min(pad, self.dec.cfg.max_len)
+            padded = jnp.concatenate(
+                [prompt, jnp.zeros((1, pad - t0), prompt.dtype)], axis=1
+            )
             small = self.dec.init_cache(1)
             logits, small = self.dec.make_step()(
-                self.params, small, prompt
+                self.params, small, padded
             )
             table_row = np.zeros((self.MB,), np.int32)
-            owned = np.full((self.MB,), -1, np.int32)
             for j, blk in enumerate(blocks):
                 table_row[j] = blk
-                owned[j] = blk
             self.pool_k, self.pool_v = self._insert(
                 self.pool_k,
                 self.pool_v,
                 small["k"],
                 small["v"],
                 jnp.asarray(table_row),
-                jnp.asarray(owned),
             )
             first = jnp.argmax(logits[:, t0 - 1, :], axis=-1)[
                 :, None
@@ -297,7 +294,9 @@ class PagedDecodeServer:
         )
         self.ticks += 1
         nxt = jnp.argmax(logits[:, -1, :], axis=-1)
-        host_nxt = np.asarray(nxt)
+        # Host transfer only when eos detection needs the values —
+        # the no-eos path stays async (same guard as the flat server).
+        host_nxt = np.asarray(nxt) if self.eos_id is not None else None
         for i, slot in enumerate(self.slots):
             if slot is None:
                 continue
@@ -344,15 +343,10 @@ def serve_paged(
         eos_id=eos_id,
     )
     rids = [srv.submit(p, s) for p, s in requests]
-    peak = 0
-    while srv.pending or any(srv.slots):
-        srv._admit()
-        peak = max(peak, srv.blocks_in_use)
-        srv._tick()
-    done = srv.done
+    done = srv.run()
     stats = {
         "ticks": srv.ticks,
-        "peak_blocks": peak,
+        "peak_blocks": srv.blocks_peak,
         "pool_blocks": int(srv.pool_k.shape[1]) - 1,
         "block_size": block_size,
         "flat_equivalent_rows": max_batch * dec.cfg.max_len,
