@@ -114,6 +114,23 @@ def truncate_logits(
     return logits
 
 
+def _flash_decode_mode() -> str | None:
+    """Which attention path the T=1 decode step takes: None (the XLA
+    einsum — default off-TPU and on tunneled backends), "tpu" (the
+    pallas flash-decode kernel, when the backend can run Mosaic), or
+    "interpret" (DEFER_TPU_PALLAS_INTERPRET=1 — the kernel through the
+    pallas interpreter, for CI parity tests off-TPU). Checked at trace
+    time; set the env before building steps (compiled steps are
+    memoized)."""
+    import os
+
+    if os.environ.get("DEFER_TPU_PALLAS_INTERPRET") == "1":
+        return "interpret"
+    from defer_tpu.ops.attention import _pallas_available
+
+    return "tpu" if _pallas_available() else None
+
+
 #: Host-sync cadence for eos early-stop polling: `finished.all()` is a
 #: blocking device round trip, so the decode loops check it every K
 #: tokens instead of every token — early stop costs at most K-1 wasted
@@ -451,19 +468,47 @@ class GptDecoder:
                 if cfg.window is not None:
                     mask &= j[None, :] > tt - cfg.window
 
-        hkv = k_att.shape[1]
-        qg = q.reshape(b, hkv, h_q // hkv, t, dh)
-        logits = jnp.einsum(
-            "bkgtd,bksd->bkgts",
-            qg,
-            k_att,
-            preferred_element_type=jnp.float32,
-        ) * (dh**-0.5)
-        logits = jnp.where(mask, logits, -jnp.inf)
-        weights = jax.nn.softmax(logits, axis=-1).astype(dt)
-        attn = jnp.einsum("bkgts,bksd->bkgtd", weights, v_att)
-        attn = attn.reshape(b, h_q, t, dh)
-        attn = attn.transpose(0, 2, 1, 3).reshape(b, t, h_q * dh)
+        from defer_tpu.ops.pallas_attention import _pick_block
+
+        flash_mode = (
+            _flash_decode_mode()
+            if t == 1
+            and not self.rolling_cache
+            and _pick_block(k_att.shape[2], 256) >= 8
+            else None
+        )
+        if flash_mode is not None:
+            # Serving hot path: the pallas flash-decode kernel fuses
+            # mask + online softmax + weighted sum over only the LIVE
+            # cache rows (ops/pallas_attention.py::flash_decode);
+            # position masking semantics match the einsum path (query
+            # at pos attends j <= pos, window optional).
+            from defer_tpu.ops.pallas_attention import flash_decode
+
+            posv = pos if per_slot else jnp.broadcast_to(pos, (b,))
+            attn = flash_decode(
+                q[:, :, 0, :],
+                k_att,
+                v_att,
+                posv,
+                window=cfg.window,
+                interpret=flash_mode == "interpret",
+            )  # [B, Hq, Dh]
+            attn = attn.astype(dt).reshape(b, t, h_q * dh)
+        else:
+            hkv = k_att.shape[1]
+            qg = q.reshape(b, hkv, h_q // hkv, t, dh)
+            logits = jnp.einsum(
+                "bkgtd,bksd->bkgts",
+                qg,
+                k_att,
+                preferred_element_type=jnp.float32,
+            ) * (dh**-0.5)
+            logits = jnp.where(mask, logits, -jnp.inf)
+            weights = jax.nn.softmax(logits, axis=-1).astype(dt)
+            attn = jnp.einsum("bkgts,bksd->bkgtd", weights, v_att)
+            attn = attn.reshape(b, h_q, t, dh)
+            attn = attn.transpose(0, 2, 1, 3).reshape(b, t, h_q * dh)
         attn = attn @ W("wo")
         if tp_axis is not None:
             attn = lax.psum(attn, tp_axis)

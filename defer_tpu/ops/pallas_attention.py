@@ -174,6 +174,135 @@ def _flash_bwd(causal, interpret, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _decode_kernel(
+    pos_ref,
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    *,
+    sm_scale: float,
+    block_k: int,
+    window: int | None,
+):
+    """One (batch, kv-head) cell: the query GROUP (G rows sharing this
+    KV head — GQA) attends the cache with the online-softmax
+    recurrence, streaming K/V blocks through VMEM. `pos` is the index
+    of the LAST valid key (inclusive); the loop bounds skip blocks
+    wholly outside [pos-window+1, pos], so decode reads O(live rows),
+    not O(max_len)."""
+    q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # (G, d)
+    g = q.shape[0]
+    p_b = pos_ref[0, 0]
+
+    def body(i, carry):
+        m, l, acc = carry
+        k = k_ref[0, 0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = lax.dot_general(
+            q,
+            k,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (G, block_k)
+        cols = i * block_k + lax.broadcasted_iota(
+            jnp.int32, (g, block_k), 1
+        )
+        mask = cols <= p_b
+        if window is not None:
+            mask &= cols > p_b - window
+        s = jnp.where(mask, s, _MASK_VALUE)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l * alpha + p.sum(axis=-1)
+        acc_new = acc * alpha[:, None] + lax.dot_general(
+            p,
+            v,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    # Dynamic trip bounds: only blocks intersecting the live window.
+    hi = p_b // block_k + 1
+    lo = (
+        jnp.maximum(p_b - window + 1, 0) // block_k
+        if window is not None
+        else jnp.int32(0)
+    )
+    init = (
+        jnp.full((g,), _MASK_VALUE, jnp.float32),
+        jnp.zeros((g,), jnp.float32),
+        jnp.zeros((g, q.shape[1]), jnp.float32),
+    )
+    _, l, acc = lax.fori_loop(lo, hi, body, init)
+    o_ref[0, 0] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def flash_decode(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    pos: jax.Array,
+    *,
+    window: int | None = None,
+    interpret: bool = False,
+    block_k: int = 256,
+) -> jax.Array:
+    """Flash-decode: ONE query token per sequence against the KV cache
+    — the serving hot op (decode is cache-bandwidth bound; this fuses
+    mask + online softmax + weighted sum into one pass over the live
+    cache rows and never materializes the [B, H, S] score matrix in
+    HBM).
+
+    q [B, Hq, Dh]; k/v [B, Hkv, S, Dh] (GQA: Hq = G*Hkv, the group
+    attends its shared KV head); pos [B] int32 = index of each
+    sequence's last valid key, INCLUSIVE (per-slot depths — continuous
+    batching — are the native shape; broadcast a scalar for uniform
+    batches). Returns [B, Hq, Dh].
+
+    Query groups narrower than 8 rows are zero-padded to the TPU
+    sublane tile and sliced back (padded rows attend garbage that is
+    discarded). The position scalar rides a (B, 1) VMEM tile.
+    """
+    b, hq, d = q.shape
+    _, hkv, s, _ = k.shape
+    if hq % hkv:
+        raise ValueError(f"Hq={hq} must be a multiple of Hkv={hkv}")
+    g = hq // hkv
+    bk = _pick_block(s, block_k)
+    if bk < 8:
+        raise ValueError(f"no tile-friendly K block for cache len {s}")
+    g_pad = max(g, 8)
+    qg = q.reshape(b, hkv, g, d)
+    if g_pad != g:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, g_pad - g), (0, 0)))
+    pos2 = jnp.broadcast_to(
+        jnp.asarray(pos, jnp.int32).reshape(-1, 1), (b, 1)
+    )
+    kernel = functools.partial(
+        _decode_kernel,
+        sm_scale=d**-0.5,
+        block_k=bk,
+        window=window,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b, hkv),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, 1, g_pad, d), lambda i, j: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda i, j: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda i, j: (i, j, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, g_pad, d), lambda i, j: (i, j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, hkv, g_pad, d), q.dtype),
+        interpret=interpret,
+    )(pos2, qg, k, v)
+    return out[:, :, :g, :].reshape(b, hq, d)
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,

@@ -135,7 +135,21 @@ class PagedDecodeServer:
     def _build(self):
         if self._step is not None:
             return
-        dec, cfg, bs = self.dec, self.dec.cfg, self.bs
+        # Memoized ON THE DECODER (utils/memo.py): jit's cache is keyed
+        # on the function object, so per-server closures would re-trace
+        # and re-compile on every new server over the same decoder
+        # (e.g. back-to-back bench runs).
+        from defer_tpu.utils.memo import cached_step
+
+        self._step = cached_step(
+            self.dec, ("paged_step", self.bs), self._build_step
+        )
+        self._insert = cached_step(
+            self.dec, ("paged_insert", self.bs), self._build_insert
+        )
+
+    def _build_step(self):
+        dec, bs = self.dec, self.bs
 
         def step(params, pk, pv, tables, pos, ids):
             b = ids.shape[0]
@@ -172,7 +186,10 @@ class PagedDecodeServer:
             logits = dec._final_logits(params, x)
             return logits, pk, pv
 
-        self._step = jax.jit(step, donate_argnums=(1, 2))
+        return jax.jit(step, donate_argnums=(1, 2))
+
+    def _build_insert(self):
+        bs = self.bs
 
         def insert(pk, pv, small_k, small_v, table_row):
             """Scatter a contiguous single-request prefill cache
@@ -208,7 +225,7 @@ class PagedDecodeServer:
             pv = pv.at[:, table_row].set(v_blocks)
             return pk, pv
 
-        self._insert = jax.jit(insert, donate_argnums=(0, 1))
+        return jax.jit(insert, donate_argnums=(0, 1))
 
     def _admit(self) -> None:
         for i in range(self.B):
