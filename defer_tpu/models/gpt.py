@@ -341,7 +341,16 @@ class GptDecoder:
         dh = self.cfg.dim // self.cfg.num_heads
         return x.reshape(b, t, d // dh, dh).transpose(0, 2, 1, 3)
 
-    def _block(self, p: dict, x, k_cache, v_cache, pos, tp_axis=None):
+    def _block(
+        self,
+        p: dict,
+        x,
+        k_cache,
+        v_cache,
+        pos,
+        tp_axis=None,
+        adapter_ids=None,
+    ):
         """One decoder block on [B, T, D] with cache update; returns
         (out, new_k, new_v). Under shard_map with tp_axis set, the
         projections arrive column-sharded (this shard's head group),
@@ -372,10 +381,26 @@ class GptDecoder:
         def bias(h, name):
             return h + p[name].astype(dt) if name in p else h
 
+        def proj(h, name):
+            """Base matmul plus, in multi-LoRA serving, each batch
+            row's OWN adapter delta: the per-layer adapter banks
+            ({name}:a [A, in, r] / {name}:b [A, r, out], pre-scaled —
+            parallel/lora.py::stack_adapters) are gathered by the
+            slot's adapter id, so one weight read serves every tenant
+            and only the two skinny per-row einsums differ."""
+            y = h @ W(name)
+            a = p.get(f"{name}:a")
+            if a is not None and adapter_ids is not None:
+                a_sel = a[adapter_ids].astype(dt)  # [B, in, r]
+                b_sel = p[f"{name}:b"][adapter_ids].astype(dt)
+                low = jnp.einsum("btd,bdr->btr", h, a_sel)
+                y = y + jnp.einsum("btr,bro->bto", low, b_sel)
+            return y
+
         h = norm_apply(cfg, x, p, "ln1")
-        qf = bias(h @ W("wq"), "bq")
-        kf = bias(h @ W("wk"), "bk")
-        vf = bias(h @ W("wv"), "bv")
+        qf = bias(proj(h, "wq"), "bq")
+        kf = bias(proj(h, "wk"), "bk")
+        vf = bias(proj(h, "wv"), "bv")
         if cfg.pos_style == "rope":
             steps_r = jnp.arange(qf.shape[1])
             positions = (
@@ -509,21 +534,21 @@ class GptDecoder:
             attn = jnp.einsum("bkgts,bksd->bkgtd", weights, v_att)
             attn = attn.reshape(b, h_q, t, dh)
             attn = attn.transpose(0, 2, 1, 3).reshape(b, t, h_q * dh)
-        attn = attn @ W("wo")
+        attn = proj(attn, "wo")
         if tp_axis is not None:
             attn = lax.psum(attn, tp_axis)
         attn = bias(attn, "bo")
         x = x + attn
         h2 = norm_apply(cfg, x, p, "ln2")
         if cfg.ffn_style == "swiglu":
-            gate = jax.nn.silu(h2 @ W("w1"))
-            ff = (gate * (h2 @ W("w3"))) @ W("w2")
+            gate = jax.nn.silu(proj(h2, "w1"))
+            ff = proj(gate * proj(h2, "w3"), "w2")
             if tp_axis is not None:
                 ff = lax.psum(ff, tp_axis)
             return x + ff, k_cache, v_cache
-        ff = bias(h2 @ W("w1"), "b1")
+        ff = bias(proj(h2, "w1"), "b1")
         ff = jax.nn.gelu(ff)
-        ff = ff @ W("w2")
+        ff = proj(ff, "w2")
         if tp_axis is not None:
             ff = lax.psum(ff, tp_axis)
         return bias(x + ff, "b2"), k_cache, v_cache
@@ -538,12 +563,18 @@ class GptDecoder:
         def step(params, cache, ids):
             t = ids.shape[1]
             pos = cache["pos"]
+            # Multi-LoRA serving: the slot -> adapter assignment is
+            # per-slot state and rides in the cache.
+            adapter_ids = cache.get("adapter")
             x = self._embed_tokens(params, ids, pos, tp_axis)
 
             def body(carry, layer):
                 x = carry
                 p, kc, vc = layer
-                out, kc, vc = self._block(p, x, kc, vc, pos, tp_axis=tp_axis)
+                out, kc, vc = self._block(
+                    p, x, kc, vc, pos,
+                    tp_axis=tp_axis, adapter_ids=adapter_ids,
+                )
                 return out, (kc, vc)
 
             x, (new_k, new_v) = lax.scan(
@@ -551,6 +582,8 @@ class GptDecoder:
             )
             logits = self._final_logits(params, x)
             new_cache = {"k": new_k, "v": new_v, "pos": pos + t}
+            if adapter_ids is not None:
+                new_cache["adapter"] = adapter_ids
             return logits, new_cache
 
         return step

@@ -94,6 +94,59 @@ def merge_lora(params: dict, cfg) -> dict:
     return out
 
 
+def stack_adapters(params: dict, adapter_trees: list, cfg) -> dict:
+    """Attach N fine-tuned adapter trees for MULTI-LoRA serving.
+
+    Each tree is a split_lora adapter half ({"stack": {"wq:a": [L, in,
+    r], ...}}) from the same lora config. The banks stack on a new
+    adapter axis — {t}:a [L, A+1, in, r] / {t}:b [L, A+1, r, out] —
+    with id 0 reserved as the ZERO adapter (base-model behavior), so a
+    serving batch mixes tenants and plain-base requests freely
+    (GptDecoder._block gathers each row's bank by its slot's adapter
+    id; runtime/decode_server.py::submit(adapter_id=...)).
+
+    cfg.lora_scale is folded into the stored b factors here — serving
+    then needs no scale plumbing, and the per-row delta is exactly the
+    merge_lora delta for that adapter id.
+    """
+    if not adapter_trees:
+        raise ValueError("no adapter trees")
+    keys = sorted(
+        k for k in adapter_trees[0]["stack"] if k.endswith(":a")
+    )
+    if not keys:
+        raise ValueError("adapter trees carry no ':a' factors")
+    for tree in adapter_trees[1:]:
+        if sorted(
+            k for k in tree["stack"] if k.endswith(":a")
+        ) != keys:
+            raise ValueError(
+                "adapter trees disagree on targets — all tenants must "
+                "come from the same lora config"
+            )
+    scale = cfg.lora_scale
+    stack = dict(params["stack"])
+    for key in keys:
+        t = key[:-2]
+        a = jnp.stack(
+            [tree["stack"][key] for tree in adapter_trees], axis=1
+        )  # [L, A, in, r]
+        b = (
+            jnp.stack(
+                [tree["stack"][f"{t}:b"] for tree in adapter_trees],
+                axis=1,
+            )
+            * scale
+        )
+        stack[key] = jnp.concatenate(
+            [jnp.zeros_like(a[:, :1]), a], axis=1
+        )
+        stack[f"{t}:b"] = jnp.concatenate(
+            [jnp.zeros_like(b[:, :1]), b], axis=1
+        )
+    return {**params, "stack": stack}
+
+
 def make_lora_train_step(
     sb,
     optimizer: optax.GradientTransformation,

@@ -86,10 +86,39 @@ class DecodeServer:
         self.step = dec.make_step()  # batched ticks (donating)
         cache = dec.init_cache(max_batch)
         cache["pos"] = jnp.zeros((max_batch,), jnp.int32)
+        # Multi-LoRA serving: adapter banks attached to the params
+        # (parallel/lora.py::stack_adapters) make the slot -> adapter
+        # assignment per-slot cache state; id 0 = base model.
+        self.multi_lora = any(
+            k.endswith(":a") for k in params.get("stack", {})
+        )
+        if self.multi_lora:
+            bank = next(
+                v
+                for k, v in params["stack"].items()
+                if k.endswith(":a")
+            )
+            if bank.ndim != 4:
+                # A 3-D [L, in, r] factor is an UNMERGED single-LoRA
+                # training tree, not a stacked bank — reject loudly
+                # instead of reading num_adapters off the wrong axis.
+                raise ValueError(
+                    "params carry unmerged LoRA factors (shape "
+                    f"{bank.shape}): merge_lora them for single-"
+                    "adapter serving, or stack_adapters for "
+                    "multi-tenant banks [L, A, in, r]"
+                )
+            cache["adapter"] = jnp.zeros((max_batch,), jnp.int32)
+            self.num_adapters = int(bank.shape[1])
         self.cache = cache
         self.prefix_len = 0
         self._prefix_cache = None
         if prefix_ids is not None:
+            if self.multi_lora:
+                raise ValueError(
+                    "prefix caching + multi-LoRA is unsupported: the "
+                    "shared prefix K/V would be adapter-dependent"
+                )
             if getattr(dec, "rolling_cache", False):
                 raise ValueError(
                     "prefix caching over a rolling cache is not "
@@ -108,7 +137,7 @@ class DecodeServer:
             _, pre = self.step(params, pre, prefix_ids)
             self._prefix_cache = pre
         self.slots = [_Slot() for _ in range(max_batch)]
-        self.pending: list[tuple[int, jax.Array, int]] = []
+        self.pending: list[tuple[int, jax.Array, int, int]] = []
         self.done: dict[int, jax.Array] = {}
         self._next_id = 0
         self.ticks = 0
@@ -118,10 +147,29 @@ class DecodeServer:
 
     # -- public API -------------------------------------------------------
 
-    def submit(self, prompt_ids: jax.Array, num_steps: int) -> int:
-        """Queue a request; returns its id (resolved in .done)."""
+    def submit(
+        self,
+        prompt_ids: jax.Array,
+        num_steps: int,
+        *,
+        adapter_id: int = 0,
+    ) -> int:
+        """Queue a request; returns its id (resolved in .done).
+        `adapter_id` selects the request's LoRA adapter when banks are
+        attached (0 = base model)."""
         if prompt_ids.shape[0] != 1:
             raise ValueError("submit one request at a time ([1, T])")
+        if adapter_id:
+            if not self.multi_lora:
+                raise ValueError(
+                    "adapter_id set but params carry no adapter banks "
+                    "(parallel/lora.py::stack_adapters)"
+                )
+            if not 0 <= adapter_id < self.num_adapters:
+                raise ValueError(
+                    f"adapter_id {adapter_id} out of range "
+                    f"[0, {self.num_adapters})"
+                )
         t0 = prompt_ids.shape[1]
         if t0 < 1:
             raise ValueError("prompt must have at least one token")
@@ -137,7 +185,7 @@ class DecodeServer:
             )
         rid = self._next_id
         self._next_id += 1
-        self.pending.append((rid, prompt_ids, num_steps))
+        self.pending.append((rid, prompt_ids, num_steps, adapter_id))
         self.solo_steps += num_steps
         return rid
 
@@ -155,7 +203,7 @@ class DecodeServer:
         for i, slot in enumerate(self.slots):
             if slot.req is not None or not self.pending:
                 continue
-            rid, prompt, steps = self.pending.pop(0)
+            rid, prompt, steps, adapter_id = self.pending.pop(0)
             t0 = prompt.shape[1]
             P = self.prefix_len
             # Bucketed prefill keeps the compiled-shape set small.
@@ -173,10 +221,12 @@ class DecodeServer:
                 small = jax.tree_util.tree_map(
                     jnp.array, self._prefix_cache
                 )
+            if self.multi_lora:
+                small["adapter"] = jnp.full((1,), adapter_id, jnp.int32)
             logits, small = self.step(self.params, small, padded)
             # Insert the lane: K/V rows land in slot i; rows past
             # P + t0 are stale but position-masked until overwritten.
-            self.cache = {
+            new_cache = {
                 "k": jax.lax.dynamic_update_slice(
                     self.cache["k"], small["k"], (0, i, 0, 0, 0)
                 ),
@@ -185,6 +235,11 @@ class DecodeServer:
                 ),
                 "pos": self.cache["pos"].at[i].set(P + t0),
             }
+            if self.multi_lora:
+                new_cache["adapter"] = (
+                    self.cache["adapter"].at[i].set(adapter_id)
+                )
+            self.cache = new_cache
             first = jnp.argmax(logits[:, t0 - 1, :], axis=-1)[
                 :, None
             ].astype(prompt.dtype)

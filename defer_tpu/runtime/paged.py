@@ -62,6 +62,15 @@ class PagedDecodeServer:
     ):
         if getattr(dec, "rolling_cache", False):
             raise ValueError("paged serving does not support rolling caches")
+        if any(k.endswith(":a") for k in params.get("stack", {})):
+            # The paged step passes no adapter ids, so attached banks
+            # would be SILENTLY ignored — refuse rather than serve the
+            # base model for multi-tenant params.
+            raise ValueError(
+                "paged serving does not support LoRA adapter banks "
+                "yet — use the flat DecodeServer for multi-LoRA, or "
+                "merge_lora for a single adapter"
+            )
         if block_size < 1 or num_blocks < 2:
             raise ValueError(
                 f"need block_size >= 1 and num_blocks >= 2 (one trash "
