@@ -32,7 +32,7 @@ from defer_tpu.parallel import (
     make_mesh,
 )
 
-__version__ = "0.4.0"
+__version__ = "0.5.0"
 
 __all__ = [
     "DEFER",
