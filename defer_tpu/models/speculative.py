@@ -41,17 +41,33 @@ def speculative_generate(
     num_steps: int,
     *,
     k: int = 4,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    min_p: float = 0.0,
+    rng: jax.Array | None = None,
 ) -> tuple[jax.Array, dict]:
-    """Greedy speculative continuation of `prompt_ids` [1, T0].
+    """Speculative continuation of `prompt_ids` [1, T0].
 
-    Returns (ids [1, T0 + num_steps], stats): ids are bit-identical to
-    `target.generate(target_params, prompt_ids, num_steps)` at
-    temperature 0, and stats carries the speedup evidence —
-    `target_steps` (target weight reads taken, incl. prefill) vs
-    `plain_steps`, and `acceptance` (the FRACTION of proposed tokens
-    accepted, in [0, 1]; expected tokens per verify forward is
-    acceptance*k + 1). Batch 1 only: acceptance length varies per
-    element while the cache write head is one scalar.
+    temperature 0 (default): greedy acceptance — ids are bit-identical
+    to `target.generate(target_params, prompt_ids, num_steps)`.
+
+    temperature > 0: SPECULATIVE SAMPLING (Leviathan/Chen): the draft
+    SAMPLES k tokens from its filtered distribution q, the target's
+    one verify forward yields p at every position, token x_j is
+    accepted with probability min(1, p_j(x_j)/q_j(x_j)), and the first
+    rejection resamples from the normalized residual max(p_j - q_j, 0)
+    — the output distribution is EXACTLY what sampling the target
+    directly would produce (the distribution-preservation property the
+    tests check empirically). top_k/top_p filter BOTH p and q the same
+    way sample_token would.
+
+    Returns (ids [1, T0 + num_steps], stats): stats carries the
+    speedup evidence — `target_steps` (target weight reads taken,
+    incl. prefill) vs `plain_steps`, and `acceptance` (the FRACTION of
+    proposed tokens accepted, in [0, 1]; expected tokens per verify
+    forward is acceptance*k + 1). Batch 1 only: acceptance length
+    varies per element while the cache write head is one scalar.
 
     Invariant kept across rounds: the target cache covers `ids` except
     at most one trailing token; the draft cache covers `ids` except
@@ -81,6 +97,25 @@ def speculative_generate(
                 f"{name} max_len {dec.cfg.max_len}"
             )
 
+    sampled = temperature > 0
+    if sampled and rng is None:
+        rng = jax.random.key(0)
+
+    from defer_tpu.models.gpt import truncate_logits
+
+    def filt(raw_logits):
+        """Raw model logits -> FILTERED logits (temperature +
+        top-k/top-p/min-p masking to -inf-scale) — applied identically
+        to target p and draft q, as sample_token would. Sampling draws
+        categorical on these directly (masked tokens exactly
+        unsampleable); softmax of them is the matching distribution."""
+        return truncate_logits(
+            raw_logits.astype(jnp.float32) / temperature,
+            top_k=top_k,
+            top_p=top_p,
+            min_p=min_p,
+        )
+
     tstep = target.make_step()
     dstep = draft.make_step()
     tcache = target.init_cache(1)
@@ -102,14 +137,27 @@ def speculative_generate(
     while ids.shape[1] - t0 < num_steps:
         n0 = ids.shape[1]
         # 1. Draft proposes k tokens, starting from its missing last
-        #    accepted token (greedy draft).
+        #    accepted token (greedy argmax, or samples from q with the
+        #    per-position distributions kept for the accept test).
         feed = ids[:, -1:]
         proposals = []
+        q_dists = []
         for _ in range(k):
             dlg, dcache = dstep(draft_params, dcache, feed)
-            feed = jnp.argmax(dlg[:, -1, :], axis=-1)[:, None].astype(
-                ids.dtype
-            )
+            if sampled:
+                qlog = filt(dlg[:, -1, :])
+                rng, sub = jax.random.split(rng)
+                # Categorical on the masked logits directly — filtered
+                # tokens are exactly unsampleable (same form as
+                # sample_token).
+                feed = jax.random.categorical(sub, qlog, axis=-1)[
+                    :, None
+                ].astype(ids.dtype)
+                q_dists.append(jax.nn.softmax(qlog, axis=-1))
+            else:
+                feed = jnp.argmax(dlg[:, -1, :], axis=-1)[
+                    :, None
+                ].astype(ids.dtype)
             proposals.append(feed)
         prop = jnp.concatenate(proposals, axis=1)  # [1, k]
         # Draft cache now covers ids + p1..p_{k-1} (p_k never fed).
@@ -129,18 +177,63 @@ def speculative_generate(
         # token before it: last_logits for p1 when nothing pended,
         # else in-round logits.
         base = last_logits if t_missing == 0 else vlogits[:, 0, :]
-        preds = jnp.concatenate(
-            [
-                jnp.argmax(base, axis=-1)[:, None],
-                jnp.argmax(
-                    vlogits[:, t_missing : t_missing + k - 1, :], axis=-1
-                ),
-            ],
-            axis=1,
-        ).astype(ids.dtype)  # [1, k]
 
-        matches = np.asarray(jax.device_get(preds[0] == prop[0]))
-        a = k if matches.all() else int(matches.argmin())
+        def p_raw(j):
+            """Target logits predicting proposal j (0-indexed)."""
+            return base if j == 0 else vlogits[:, t_missing + j - 1, :]
+
+        if sampled:
+            # Accept/reject per position: keep x_j with prob
+            # min(1, p(x_j)/q(x_j)); first rejection resamples from
+            # the normalized residual max(p - q, 0). Exactly the
+            # target's sampling distribution, proven in the tests.
+            # ONE batched device->host transfer carries everything the
+            # host loop needs (the codebase keeps per-scalar syncs out
+            # of decode loops — see EOS_POLL_EVERY).
+            p_all = jax.nn.softmax(
+                jnp.concatenate([filt(p_raw(j)) for j in range(k)]),
+                axis=-1,
+            )  # [k, V]
+            q_all = jnp.concatenate(q_dists, axis=0)  # [k, V]
+            rng, sub_u, sub_r = jax.random.split(rng, 3)
+            u_vec = jax.random.uniform(sub_u, (k,))
+            xs = prop[0]
+            sel = jnp.arange(k)
+            host = jax.device_get(
+                (xs, u_vec, p_all[sel, xs], q_all[sel, xs])
+            )
+            xs_h, u_h, p_h, q_h = (np.asarray(t) for t in host)
+            a = k
+            replacement = None
+            for j in range(k):
+                if u_h[j] < min(1.0, float(p_h[j]) / max(float(q_h[j]), 1e-38)):
+                    continue
+                a = j
+                residual = jnp.maximum(p_all[j] - q_all[j], 0.0)
+                total = residual.sum()
+                # p == q exactly at this position would make the
+                # residual empty, but then the accept ratio is 1 and
+                # rejection is unreachable; guard anyway.
+                src = jnp.where(total > 0, residual / total, p_all[j])
+                replacement = jax.random.categorical(
+                    jax.random.fold_in(sub_r, j),
+                    jnp.log(jnp.maximum(src, 1e-38)),
+                )[None, None].astype(ids.dtype)
+                break
+        else:
+            preds = jnp.concatenate(
+                [
+                    jnp.argmax(base, axis=-1)[:, None],
+                    jnp.argmax(
+                        vlogits[:, t_missing : t_missing + k - 1, :],
+                        axis=-1,
+                    ),
+                ],
+                axis=1,
+            ).astype(ids.dtype)  # [1, k]
+            matches = np.asarray(jax.device_get(preds[0] == prop[0]))
+            a = k if matches.all() else int(matches.argmin())
+            replacement = None if a == k else preds[:, a : a + 1]
         rounds += 1
         accepted_total += a
 
@@ -150,11 +243,12 @@ def speculative_generate(
             # after p_k.
             last_logits = vlogits[:, t_missing + k - 1, :]
         else:
-            # Target's own token replaces the first mismatch; it has
-            # not been fed, so it becomes the target's pending token
-            # (next round's base comes from in-round logits, so
+            # The corrected token (target argmax in greedy mode, the
+            # residual sample otherwise) replaces the first rejection;
+            # it has not been fed, so it becomes the target's pending
+            # token (next round's base comes from in-round logits, so
             # last_logits is dead until the caches catch up).
-            new = jnp.concatenate([prop[:, :a], preds[:, a : a + 1]], axis=1)
+            new = jnp.concatenate([prop[:, :a], replacement], axis=1)
         ids = jnp.concatenate([ids, new], axis=1)
         n1 = ids.shape[1]
 

@@ -215,6 +215,10 @@ def main() -> None:
         out, stats = speculative_generate(
             dec, params, draft, dparams, short, args.steps,
             k=args.speculate,
+            temperature=args.temperature,
+            top_k=args.top_k,
+            top_p=args.top_p,
+            min_p=args.min_p,
         )
         jax.block_until_ready(out)
         dt = time.perf_counter() - t0

@@ -95,6 +95,77 @@ def test_speculative_llama_target():
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+def test_sampled_speculative_deterministic_and_in_vocab():
+    """temperature > 0: reproducible under a fixed rng, divergent
+    under different rngs, tokens in vocab, sane stats."""
+    target, draft = _target(), _draft()
+    tp, dp = target.init(jax.random.key(0)), draft.init(jax.random.key(1))
+    prompt = jax.random.randint(jax.random.key(2), (1, 4), 0, 96)
+    a, sa = speculative_generate(
+        target, tp, draft, dp, prompt, 10, k=3,
+        temperature=0.9, top_p=0.95, rng=jax.random.key(7),
+    )
+    b, _ = speculative_generate(
+        target, tp, draft, dp, prompt, 10, k=3,
+        temperature=0.9, top_p=0.95, rng=jax.random.key(7),
+    )
+    c, _ = speculative_generate(
+        target, tp, draft, dp, prompt, 10, k=3,
+        temperature=0.9, top_p=0.95, rng=jax.random.key(8),
+    )
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+    assert a.shape == (1, 14)
+    toks = np.asarray(a)
+    assert toks.min() >= 0 and toks.max() < 96
+    assert 0.0 <= sa["acceptance"] <= 1.0
+
+
+@pytest.mark.slow
+def test_sampled_speculative_preserves_target_distribution():
+    """The distribution-preservation theorem, empirically: the first
+    token from speculative sampling is distributed as the TARGET's own
+    filtered softmax — total-variation distance to the exact p stays
+    at the sampling-noise floor. (A broken accept rule — e.g. taking
+    q or a p/q mixture — shifts TV by the draft/target disagreement,
+    an order of magnitude above this tolerance.)"""
+    import collections
+
+    from defer_tpu.models.gpt import truncate_logits
+
+    vocab = 16
+    cfg = dict(
+        num_layers=1, dim=32, num_heads=2, ffn_dim=64,
+        vocab_size=vocab, max_len=16, norm_style="pre",
+    )
+    target = GptDecoder(TransformerConfig(**cfg), compute_dtype=jnp.float32)
+    draft = GptDecoder(TransformerConfig(**cfg), compute_dtype=jnp.float32)
+    tp = target.init(jax.random.key(0))
+    dp = draft.init(jax.random.key(5))  # different weights: q != p
+    prompt = jnp.asarray([[3, 7, 1]], jnp.int32)
+    temp = 1.2
+
+    # Exact target distribution for the first generated token.
+    last, _ = target.prefill(tp, target.init_cache(1), prompt)
+    p = np.asarray(
+        jax.nn.softmax(
+            truncate_logits(last.astype(jnp.float32) / temp), axis=-1
+        )
+    )[0]
+
+    n = 1500
+    counts = collections.Counter()
+    for i in range(n):
+        ids, _ = speculative_generate(
+            target, tp, draft, dp, prompt, 1, k=2,
+            temperature=temp, rng=jax.random.key(100 + i),
+        )
+        counts[int(np.asarray(ids)[0, 3])] += 1
+    freq = np.asarray([counts[t] / n for t in range(vocab)])
+    tv = 0.5 * np.abs(freq - p).sum()
+    assert tv < 0.08, (tv, freq, p)
+
+
 def test_speculative_input_validation():
     target, draft = _target(), _draft()
     tp = target.init(jax.random.key(0))
