@@ -191,18 +191,17 @@ def speculative_generate(
             # host loop needs (the codebase keeps per-scalar syncs out
             # of decode loops — see EOS_POLL_EVERY).
             p_all = jax.nn.softmax(
-                jnp.concatenate([filt(p_raw(j)) for j in range(k)]),
+                filt(jnp.concatenate([p_raw(j) for j in range(k)])),
                 axis=-1,
-            )  # [k, V]
+            )  # [k, V] — one batched filter, not k row dispatches
             q_all = jnp.concatenate(q_dists, axis=0)  # [k, V]
             rng, sub_u, sub_r = jax.random.split(rng, 3)
             u_vec = jax.random.uniform(sub_u, (k,))
-            xs = prop[0]
             sel = jnp.arange(k)
             host = jax.device_get(
-                (xs, u_vec, p_all[sel, xs], q_all[sel, xs])
+                (u_vec, p_all[sel, prop[0]], q_all[sel, prop[0]])
             )
-            xs_h, u_h, p_h, q_h = (np.asarray(t) for t in host)
+            u_h, p_h, q_h = (np.asarray(t) for t in host)
             a = k
             replacement = None
             for j in range(k):

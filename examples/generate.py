@@ -188,6 +188,13 @@ def main() -> None:
     )
 
     if args.speculate and args.tp == 1 and args.batch == 1:
+        if args.rep_penalty != 1.0:
+            print(
+                "note: --rep-penalty is not applied on the speculative "
+                "path (its acceptance math covers the filtered softmax "
+                "policy only), so the two decodes sample different "
+                "policies"
+            )
         import dataclasses
 
         from defer_tpu.models.speculative import speculative_generate

@@ -46,8 +46,8 @@ def test_generate_example_llama_speculative():
     assert "steady decode" in out and "speculative" in out
 
 
-@pytest.mark.parametrize("prefix", [0, 6])
-def test_serve_decode_example_checked(prefix):
+@pytest.mark.parametrize("prefix,adapters", [(0, 0), (6, 0), (0, 2)])
+def test_serve_decode_example_checked(prefix, adapters):
     args = [
         "examples/serve_decode.py", "--layers", "2", "--dim", "64",
         "--heads", "4", "--ffn", "128", "--vocab", "96",
@@ -56,6 +56,8 @@ def test_serve_decode_example_checked(prefix):
     ]
     if prefix:
         args += ["--prefix", str(prefix)]
+    if adapters:
+        args += ["--adapters", str(adapters)]
     out = _run(args)
     assert "valid greedy choices" in out
     if prefix:
