@@ -136,16 +136,26 @@ def _place_zero1(opt_state, params, mesh, zero1: bool, cell: list):
     return jax.device_put(opt_state, sh)
 
 
-def _make_update_step(optimizer, loss_fn, zero1: bool, opt_shardings: list):
-    """The one donated train-step body both factories share:
+def _make_update_step(
+    optimizer,
+    loss_fn,
+    zero1: bool,
+    opt_shardings: list,
+    *,
+    has_aux: bool = False,
+):
+    """The one donated train-step body every factory shares:
     value_and_grad over loss_fn(params, *batch), optimizer update,
     ZeRO-1 re-constraint (without it XLA may resolve the elementwise
     moment update to the replicated gradient layout and silently give
-    the memory saving back), apply."""
+    the memory saving back), apply. With has_aux the step returns
+    loss_fn's full (loss, aux) tuple."""
 
     @partial(jax.jit, donate_argnums=(0,))
     def train_step(state: TrainState, *batch):
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, *batch)
+        out, grads = jax.value_and_grad(loss_fn, has_aux=has_aux)(
+            state.params, *batch
+        )
         updates, opt_state = optimizer.update(
             grads, state.opt_state, state.params
         )
@@ -154,7 +164,7 @@ def _make_update_step(optimizer, loss_fn, zero1: bool, opt_shardings: list):
                 opt_state, opt_shardings[0]
             )
         params = optax.apply_updates(state.params, updates)
-        return TrainState(params, opt_state, state.step + 1), loss
+        return TrainState(params, opt_state, state.step + 1), out
 
     return train_step
 
@@ -221,6 +231,127 @@ def make_train_step(
     )
 
 
+def _init_lm_params(sb: SpmdBert, rng: jax.Array) -> dict:
+    """GptDecoder-keyed LM parameter tree from an SpmdBert init: drop
+    the classifier pooler, add the final pre-LN norm the weight-tied
+    head expects — shared by the LM and DPO factories."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    base = sb.init(rng)
+    rep = NamedSharding(sb.mesh, P())
+    params = {
+        k: v for k, v in base.items() if k not in ("pooler_w", "pooler_b")
+    }
+    params["final_ln_scale"] = jax.device_put(jnp.ones((sb.cfg.dim,)), rep)
+    if sb.cfg.norm_type == "layer":
+        params["final_ln_bias"] = jax.device_put(
+            jnp.zeros((sb.cfg.dim,)), rep
+        )
+    return params
+
+
+def _lm_logits(sb: SpmdBert, params: dict, ids: jax.Array) -> jax.Array:
+    """The pipelined LM forward both objectives share: hidden states
+    -> final pre-LN norm -> weight-tied head, fp32 logits [M, B, S, V].
+    ONE definition keeps LM-vs-DPO and train-vs-serve parity by
+    construction."""
+    from defer_tpu.parallel.transformer_stack import _layer_norm, _rms_norm
+
+    cfg = sb.cfg
+    h = sb.make_hidden_step()(params, ids).astype(jnp.float32)
+    if cfg.norm_type == "rms":
+        h = _rms_norm(h, params["final_ln_scale"], cfg.layer_norm_eps)
+    else:
+        h = _layer_norm(
+            h,
+            params["final_ln_scale"],
+            params["final_ln_bias"],
+            cfg.layer_norm_eps,
+        )
+    return h @ params["token_embedding"].astype(jnp.float32).T
+
+
+def sequence_logprobs(
+    sb: SpmdBert, params: dict, ids: jax.Array, mask: jax.Array
+) -> jax.Array:
+    """Per-sequence sum of next-token log-probabilities over the
+    masked region: ids [M, B, S], mask [M, B, S] (1 where position t's
+    TOKEN — predicted from t-1 — counts, e.g. the completion; position
+    0 can never count). Returns [M, B] fp32.
+
+    Uses the pipelined hidden-step forward + the weight-tied pre-LN
+    head (the same math make_lm_train_step trains), so policy and
+    reference scores in DPO come from exactly the serving model."""
+    logits = _lm_logits(sb, params, ids)
+    logp = jax.nn.log_softmax(logits[..., :-1, :], axis=-1)
+    tok_lp = jnp.take_along_axis(
+        logp, ids[..., 1:, None], axis=-1
+    )[..., 0]  # [M, B, S-1]: logp of token t+1 given prefix
+    return (tok_lp * mask[..., 1:].astype(jnp.float32)).sum(axis=-1)
+
+
+def make_dpo_train_step(
+    sb: SpmdBert,
+    optimizer: optax.GradientTransformation,
+    *,
+    beta: float = 0.1,
+    zero1: bool = False,
+):
+    """Direct Preference Optimization through the SPMD pipeline.
+
+    Returns (init_state, train_step) with
+    ``train_step(state, ref_params, chosen, rejected, mask_c, mask_r)
+    -> (state, (loss, accuracy))``: chosen/rejected are [M, B, S] id
+    blocks sharing each pair's prompt, masks mark the completion
+    region, and the loss is the Bradley-Terry objective
+    ``-log sigmoid(beta * ((pi_c - ref_c) - (pi_r - ref_r)))`` with
+    the reference scores computed under stop_gradient from the frozen
+    ``ref_params`` (pass the policy's own init for the standard
+    recipe). `accuracy` is the fraction of pairs the policy currently
+    orders correctly — the metric DPO training should push up.
+
+    Same serve-direct contract as make_lm_train_step (pre-LN causal
+    stacks only): the optimized tree drops onto the KV-cache decoder.
+    """
+    if not sb.cfg.causal or sb.cfg.norm_style != "pre":
+        raise ValueError(
+            "make_dpo_train_step needs causal=True and "
+            "norm_style='pre' (the LM head convention the scores and "
+            "the serving decoder share)"
+        )
+    opt_shardings: list = []
+
+    def loss_fn(params, ref_params, chosen, rejected, mask_c, mask_r):
+        pi_c = sequence_logprobs(sb, params, chosen, mask_c)
+        pi_r = sequence_logprobs(sb, params, rejected, mask_r)
+        ref_c = jax.lax.stop_gradient(
+            sequence_logprobs(sb, ref_params, chosen, mask_c)
+        )
+        ref_r = jax.lax.stop_gradient(
+            sequence_logprobs(sb, ref_params, rejected, mask_r)
+        )
+        margin = beta * ((pi_c - ref_c) - (pi_r - ref_r))
+        loss = -jax.nn.log_sigmoid(margin).mean()
+        acc = (margin > 0).mean()
+        return loss, acc
+
+    def init_state(rng: jax.Array) -> TrainState:
+        params = _init_lm_params(sb, rng)
+        opt_state = _place_zero1(
+            optimizer.init(params), params, sb.mesh, zero1, opt_shardings
+        )
+        return TrainState(
+            params=params,
+            opt_state=opt_state,
+            step=jnp.zeros((), jnp.int32),
+        )
+
+    return init_state, _make_update_step(
+        optimizer, loss_fn, zero1, opt_shardings, has_aux=True
+    )
+
+
 def make_lm_train_step(
     sb: SpmdBert,
     optimizer: optax.GradientTransformation,
@@ -262,47 +393,18 @@ def make_lm_train_step(
             "convention, and a post-norm tree could not serve on the "
             "KV-cache decoder afterwards"
         )
-    from jax.sharding import NamedSharding
-    from jax.sharding import PartitionSpec as P
-
-    from defer_tpu.parallel.transformer_stack import _layer_norm, _rms_norm
-
-    forward = sb.make_hidden_step()
-    cfg = sb.cfg
+    sb.make_hidden_step()  # build (memoized) outside the jitted loss
     opt_shardings: list = []
 
     def loss_fn(params, ids):
-        h = forward(params, ids).astype(jnp.float32)  # [M, B, S, D]
-        if cfg.norm_type == "rms":
-            h = _rms_norm(h, params["final_ln_scale"], cfg.layer_norm_eps)
-        else:
-            h = _layer_norm(
-                h,
-                params["final_ln_scale"],
-                params["final_ln_bias"],
-                cfg.layer_norm_eps,
-            )
-        logits = h @ params["token_embedding"].astype(jnp.float32).T
+        logits = _lm_logits(sb, params, ids)
         losses = optax.softmax_cross_entropy_with_integer_labels(
             logits[..., :-1, :], ids[..., 1:]
         )
         return losses.mean()
 
     def init_state(rng: jax.Array) -> TrainState:
-        base = sb.init(rng)
-        rep = NamedSharding(sb.mesh, P())
-        params = {
-            k: v
-            for k, v in base.items()
-            if k not in ("pooler_w", "pooler_b")
-        }
-        params["final_ln_scale"] = jax.device_put(
-            jnp.ones((cfg.dim,)), rep
-        )
-        if cfg.norm_type == "layer":
-            params["final_ln_bias"] = jax.device_put(
-                jnp.zeros((cfg.dim,)), rep
-            )
+        params = _init_lm_params(sb, rng)
         opt_state = _place_zero1(
             optimizer.init(params), params, sb.mesh, zero1, opt_shardings
         )

@@ -96,14 +96,19 @@ def main() -> None:
         trees = []
         for a in range(args.adapters):
             full = init_stack(jax.random.key(100 + a), lora_cfg)
+            lkeys = sorted(k for k in full if ":" in k)
             trees.append({
                 "stack": {
-                    k: (v if k.endswith(":a")
+                    k: (full[k] if k.endswith(":a")
                         else jax.random.normal(
-                            jax.random.fold_in(jax.random.key(100 + a), 1),
-                            v.shape,
+                            # Stable per-tensor fold so same-shape b
+                            # banks are independent AND reproducible.
+                            jax.random.fold_in(
+                                jax.random.key(100 + a), 1 + lkeys.index(k)
+                            ),
+                            full[k].shape,
                         ) * 0.02)
-                    for k, v in full.items() if ":" in k
+                    for k in lkeys
                 }
             })
         params = stack_adapters(params, trees, lora_cfg)
