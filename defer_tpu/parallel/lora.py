@@ -147,6 +147,25 @@ def stack_adapters(params: dict, adapter_trees: list, cfg) -> dict:
     return {**params, "stack": stack}
 
 
+def adapter_bank_info(params: dict) -> int | None:
+    """Multi-LoRA detection shared by the serving stacks: None when
+    `params` carries no adapter factors; the bank count A+1 when
+    stacked banks ([L, A+1, in, r]) are attached; a loud ValueError
+    for unmerged 3-D training factors (which would otherwise be
+    misread as banks)."""
+    stack = params.get("stack", {})
+    bank = next((v for k, v in stack.items() if k.endswith(":a")), None)
+    if bank is None:
+        return None
+    if bank.ndim != 4:
+        raise ValueError(
+            f"params carry unmerged LoRA factors (shape {bank.shape}): "
+            "merge_lora them for single-adapter serving, or "
+            "stack_adapters for multi-tenant banks [L, A, in, r]"
+        )
+    return int(bank.shape[1])
+
+
 def make_lora_train_step(
     sb,
     optimizer: optax.GradientTransformation,

@@ -320,18 +320,23 @@ def make_dpo_train_step(
             "norm_style='pre' (the LM head convention the scores and "
             "the serving decoder share)"
         )
+    sb.make_hidden_step()  # build (memoized) outside the jitted loss
     opt_shardings: list = []
 
     def loss_fn(params, ref_params, chosen, rejected, mask_c, mask_r):
-        pi_c = sequence_logprobs(sb, params, chosen, mask_c)
-        pi_r = sequence_logprobs(sb, params, rejected, mask_r)
-        ref_c = jax.lax.stop_gradient(
-            sequence_logprobs(sb, ref_params, chosen, mask_c)
+        # Standard DPO batching trick: chosen and rejected stack on
+        # the batch axis, so the step pays TWO pipeline traversals
+        # (policy + reference), not four.
+        b = chosen.shape[1]
+        both = jnp.concatenate([chosen, rejected], axis=1)
+        mboth = jnp.concatenate([mask_c, mask_r], axis=1)
+        pi = sequence_logprobs(sb, params, both, mboth)
+        ref = jax.lax.stop_gradient(
+            sequence_logprobs(sb, ref_params, both, mboth)
         )
-        ref_r = jax.lax.stop_gradient(
-            sequence_logprobs(sb, ref_params, rejected, mask_r)
+        margin = beta * (
+            (pi[:, :b] - ref[:, :b]) - (pi[:, b:] - ref[:, b:])
         )
-        margin = beta * ((pi_c - ref_c) - (pi_r - ref_r))
         loss = -jax.nn.log_sigmoid(margin).mean()
         acc = (margin > 0).mean()
         return loss, acc

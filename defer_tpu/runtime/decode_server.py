@@ -89,27 +89,13 @@ class DecodeServer:
         # Multi-LoRA serving: adapter banks attached to the params
         # (parallel/lora.py::stack_adapters) make the slot -> adapter
         # assignment per-slot cache state; id 0 = base model.
-        self.multi_lora = any(
-            k.endswith(":a") for k in params.get("stack", {})
-        )
+        from defer_tpu.parallel.lora import adapter_bank_info
+
+        n_adapters = adapter_bank_info(params)
+        self.multi_lora = n_adapters is not None
         if self.multi_lora:
-            bank = next(
-                v
-                for k, v in params["stack"].items()
-                if k.endswith(":a")
-            )
-            if bank.ndim != 4:
-                # A 3-D [L, in, r] factor is an UNMERGED single-LoRA
-                # training tree, not a stacked bank — reject loudly
-                # instead of reading num_adapters off the wrong axis.
-                raise ValueError(
-                    "params carry unmerged LoRA factors (shape "
-                    f"{bank.shape}): merge_lora them for single-"
-                    "adapter serving, or stack_adapters for "
-                    "multi-tenant banks [L, A, in, r]"
-                )
             cache["adapter"] = jnp.zeros((max_batch,), jnp.int32)
-            self.num_adapters = int(bank.shape[1])
+            self.num_adapters = n_adapters
         self.cache = cache
         self.prefix_len = 0
         self._prefix_cache = None

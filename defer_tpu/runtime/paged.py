@@ -62,15 +62,15 @@ class PagedDecodeServer:
     ):
         if getattr(dec, "rolling_cache", False):
             raise ValueError("paged serving does not support rolling caches")
-        if any(k.endswith(":a") for k in params.get("stack", {})):
-            # The paged step passes no adapter ids, so attached banks
-            # would be SILENTLY ignored — refuse rather than serve the
-            # base model for multi-tenant params.
-            raise ValueError(
-                "paged serving does not support LoRA adapter banks "
-                "yet — use the flat DecodeServer for multi-LoRA, or "
-                "merge_lora for a single adapter"
-            )
+        # Multi-LoRA: adapter banks (parallel/lora.py::stack_adapters)
+        # make the slot -> adapter assignment per-slot state, same as
+        # the flat server; id 0 = base model.
+        from defer_tpu.parallel.lora import adapter_bank_info
+
+        n_adapters = adapter_bank_info(params)
+        self.multi_lora = n_adapters is not None
+        if self.multi_lora:
+            self.num_adapters = n_adapters
         if block_size < 1 or num_blocks < 2:
             raise ValueError(
                 f"need block_size >= 1 and num_blocks >= 2 (one trash "
@@ -94,8 +94,9 @@ class PagedDecodeServer:
         self.free = list(range(1, num_blocks))
         self.tables = np.zeros((max_batch, self.MB), np.int32)
         self.pos = np.zeros((max_batch,), np.int32)
+        self.adapter = np.zeros((max_batch,), np.int32)
         self.slots: list[dict | None] = [None] * max_batch
-        self.pending: list[tuple[int, jax.Array, int]] = []
+        self.pending: list[tuple[int, jax.Array, int, int]] = []
         self.done: dict[int, jax.Array] = {}
         self._next_id = 0
         self.ticks = 0
@@ -105,9 +106,26 @@ class PagedDecodeServer:
 
     # -- public API -------------------------------------------------------
 
-    def submit(self, prompt_ids: jax.Array, num_steps: int) -> int:
+    def submit(
+        self,
+        prompt_ids: jax.Array,
+        num_steps: int,
+        *,
+        adapter_id: int = 0,
+    ) -> int:
         if prompt_ids.ndim != 2 or prompt_ids.shape[0] != 1:
             raise ValueError("submit one request at a time ([1, T])")
+        if adapter_id:
+            if not self.multi_lora:
+                raise ValueError(
+                    "adapter_id set but params carry no adapter banks "
+                    "(parallel/lora.py::stack_adapters)"
+                )
+            if not 0 <= adapter_id < self.num_adapters:
+                raise ValueError(
+                    f"adapter_id {adapter_id} out of range "
+                    f"[0, {self.num_adapters})"
+                )
         t0 = prompt_ids.shape[1]
         if t0 < 1 or num_steps < 1:
             raise ValueError("need at least 1 prompt token and 1 step")
@@ -126,7 +144,7 @@ class PagedDecodeServer:
             )
         rid = self._next_id
         self._next_id += 1
-        self.pending.append((rid, prompt_ids, num_steps))
+        self.pending.append((rid, prompt_ids, num_steps, adapter_id))
         return rid
 
     def run(self) -> dict[int, jax.Array]:
@@ -160,7 +178,7 @@ class PagedDecodeServer:
     def _build_step(self):
         dec, bs = self.dec, self.bs
 
-        def step(params, pk, pv, tables, pos, ids):
+        def step(params, pk, pv, tables, pos, ids, adapter_ids):
             b = ids.shape[0]
             x = dec._embed_tokens(params, ids, pos)
             rows = jnp.arange(b)
@@ -179,7 +197,9 @@ class PagedDecodeServer:
                 vc = vc.transpose(0, 2, 1, 3, 4).reshape(
                     b_, hkv, mb * bs, dh
                 )
-                out, kc, vc = dec._block(p, x, kc, vc, pos)
+                out, kc, vc = dec._block(
+                    p, x, kc, vc, pos, adapter_ids=adapter_ids
+                )
                 # Scatter ONLY the new row back to its page.
                 blk = tables[rows, pos // bs]  # [B]
                 row = pos % bs
@@ -240,7 +260,7 @@ class PagedDecodeServer:
         for i in range(self.B):
             if self.slots[i] is not None or not self.pending:
                 continue
-            rid, prompt, steps = self.pending[0]
+            rid, prompt, steps, adapter_id = self.pending[0]
             t0 = prompt.shape[1]
             need = -(-(t0 + steps) // self.bs)
             if need > len(self.free):
@@ -260,6 +280,8 @@ class PagedDecodeServer:
                 [prompt, jnp.zeros((1, pad - t0), prompt.dtype)], axis=1
             )
             small = self.dec.init_cache(1)
+            if self.multi_lora:
+                small["adapter"] = jnp.full((1,), adapter_id, jnp.int32)
             logits, small = self.dec.make_step()(
                 self.params, small, padded
             )
@@ -278,6 +300,7 @@ class PagedDecodeServer:
             ].astype(prompt.dtype)
             self.tables[i] = table_row
             self.pos[i] = t0
+            self.adapter[i] = adapter_id
             slot = {
                 "rid": rid,
                 "remaining": steps - 1,
@@ -317,6 +340,7 @@ class PagedDecodeServer:
             jnp.asarray(self.tables),
             pos,
             feed,
+            jnp.asarray(self.adapter),
         )
         self.ticks += 1
         nxt = jnp.argmax(logits[:, -1, :], axis=-1)
@@ -345,6 +369,7 @@ class PagedDecodeServer:
         self.free.extend(slot["blocks"])
         self.tables[i] = 0
         self.pos[i] = 0
+        self.adapter[i] = 0
         self.slots[i] = None
 
 
@@ -357,9 +382,11 @@ def serve_paged(
     block_size: int = 16,
     max_batch: int = 4,
     eos_id: int | None = None,
+    adapter_ids: list | None = None,
 ) -> tuple[list[jax.Array], dict]:
     """One-shot paged serving; returns (outputs in submission order,
-    stats incl. peak pool usage)."""
+    stats incl. peak pool usage). `adapter_ids` optionally assigns a
+    LoRA adapter per request (parallel/lora.py::stack_adapters)."""
     srv = PagedDecodeServer(
         dec,
         params,
@@ -368,7 +395,16 @@ def serve_paged(
         max_batch=max_batch,
         eos_id=eos_id,
     )
-    rids = [srv.submit(p, s) for p, s in requests]
+    aids = adapter_ids or [0] * len(requests)
+    if len(aids) != len(requests):
+        raise ValueError(
+            f"adapter_ids has {len(aids)} entries for "
+            f"{len(requests)} requests"
+        )
+    rids = [
+        srv.submit(p, s, adapter_id=a)
+        for (p, s), a in zip(requests, aids)
+    ]
     done = srv.run()
     stats = {
         "ticks": srv.ticks,

@@ -120,15 +120,45 @@ def test_stack_adapters_validation_and_submit_guards():
             prefix_ids=jnp.asarray([[1, 2]], jnp.int32),
         )
     # An unmerged single-LoRA training tree (3-D factors) is rejected
-    # loudly, not mistaken for a stacked bank.
+    # loudly, not mistaken for a stacked bank — by both servers.
     unmerged = {
         **base,
         "stack": {**base["stack"], **trees[0]["stack"]},
     }
     with pytest.raises(ValueError, match="unmerged"):
         DecodeServer(dec, unmerged, max_batch=1)
-    # The paged server refuses banks instead of silently serving base.
     from defer_tpu.runtime.paged import PagedDecodeServer
 
-    with pytest.raises(ValueError, match="adapter banks"):
-        PagedDecodeServer(dec, params, num_blocks=4, block_size=8)
+    with pytest.raises(ValueError, match="unmerged"):
+        PagedDecodeServer(dec, unmerged, num_blocks=4, block_size=8)
+
+
+def test_paged_multilora_matches_per_adapter_merge():
+    """The paged server serves tenants too: block-pool cache + per-slot
+    adapter banks, each output equal to its merged solo decode."""
+    from defer_tpu.runtime.paged import serve_paged
+
+    dec, base, trees, lora_cfg = _setup()
+    params = stack_adapters(base, trees, lora_cfg)
+    reqs = [
+        (jnp.asarray([[3, 9, 27]], jnp.int32), 6),
+        (jnp.asarray([[5, 1]], jnp.int32), 5),
+        (jnp.asarray([[11, 2, 8]], jnp.int32), 4),
+    ]
+    aids = [1, 2, 0]
+    outs, _ = serve_paged(
+        dec, params, reqs, num_blocks=10, block_size=8, max_batch=2,
+        adapter_ids=aids,
+    )
+    for (p, s), a, got in zip(reqs, aids, outs):
+        if a == 0:
+            solo = base
+        else:
+            solo = merge_lora(
+                {**base, "stack": {**base["stack"], **trees[a - 1]["stack"]}},
+                lora_cfg,
+            )
+        want = dec.generate(solo, p, s)
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(want), err_msg=f"adapter {a}"
+        )
