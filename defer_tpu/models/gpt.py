@@ -414,50 +414,71 @@ class GptDecoder:
         b, h_q, t, _ = q.shape
 
         if self.rolling_cache:
-            if per_slot:
-                raise NotImplementedError(
-                    "rolling caches are not wired into the per-slot "
-                    "decode server yet"
-                )
             win = cfg.window
-            if t > win:
+            if per_slot:
+                # Continuous batching over rolling caches: each slot's
+                # write lands at ITS OWN pos % win, and the in-place
+                # mask vectorizes per slot. T=1 only — admission
+                # prefills each request through the scalar path
+                # (runtime/decode_server.py) before lane insertion.
+                if t != 1:
+                    raise NotImplementedError(
+                        "per-slot rolling caches decode one token per "
+                        "tick; prefill requests individually before "
+                        "lane insertion"
+                    )
+                slots = pos % win  # (B,)
+                rows_b = jnp.arange(b)
+                k_cache = k_cache.at[rows_b, :, slots, :].set(k[:, :, 0, :])
+                v_cache = v_cache.at[rows_b, :, slots, :].set(v[:, :, 0, :])
+                k_att, v_att = k_cache, v_cache
+                s_idx = jnp.arange(win)
+                held = pos[:, None] - (
+                    (pos[:, None] - s_idx[None, :]) % win
+                )  # (B, win)
+                # Broadcasts over the shared [b, hkv, g, t, s] logits.
+                mask = (held >= 0)[:, None, None, None, :]
+            elif t > win:
                 raise ValueError(
                     f"a rolling-cache step takes at most window={win} "
                     f"tokens at once (got {t}); prefill with chunk<={win}"
                 )
-            # New rows land at position % win (scatter; t <= win so
-            # slot indices are unique).
-            slots = (pos + jnp.arange(t)) % win
-            s_idx = jnp.arange(win)
-            if t == 1:
-                # Decode fast path: write first, attend the cache IN
-                # PLACE (no per-step concat copies of the whole
-                # window). After the write every slot holds the latest
-                # position <= pos congruent to it — always inside the
-                # window — so only never-written slots mask out.
-                k_cache = k_cache.at[:, :, slots, :].set(k)
-                v_cache = v_cache.at[:, :, slots, :].set(v)
-                k_att, v_att = k_cache, v_cache
-                held = pos - ((pos - s_idx) % win)  # (win,)
-                mask = (held >= 0)[None, :]  # (1, win)
-            else:
-                # Multi-token (prefill) step: attend over [cache,
-                # this step's keys] with EXPLICIT absolute positions —
-                # same-step rows never overwrite keys a same-step
-                # query still needs. Slot s holds the latest position
-                # <= pos-1 congruent to s (negative = never written).
-                held = pos - 1 - ((pos - 1 - s_idx) % win)  # (win,)
-                k_att = jnp.concatenate([k_cache, k], axis=2)
-                v_att = jnp.concatenate([v_cache, v], axis=2)
-                kpos = jnp.concatenate([held, pos + jnp.arange(t)])
-                qpos = pos + jnp.arange(t)[:, None]  # (T, 1)
-                mask = (
-                    (kpos[None, :] <= qpos)
-                    & (kpos[None, :] > qpos - win)
-                    & (kpos[None, :] >= 0)
-                )  # (T, win+T)
-                k_cache = k_cache.at[:, :, slots, :].set(k)
-                v_cache = v_cache.at[:, :, slots, :].set(v)
+            if not per_slot:
+                # New rows land at position % win (scatter; t <= win
+                # so slot indices are unique).
+                slots = (pos + jnp.arange(t)) % win
+                s_idx = jnp.arange(win)
+                if t == 1:
+                    # Decode fast path: write first, attend the cache
+                    # IN PLACE (no per-step concat copies of the whole
+                    # window). After the write every slot holds the
+                    # latest position <= pos congruent to it — always
+                    # inside the window — so only never-written slots
+                    # mask out.
+                    k_cache = k_cache.at[:, :, slots, :].set(k)
+                    v_cache = v_cache.at[:, :, slots, :].set(v)
+                    k_att, v_att = k_cache, v_cache
+                    held = pos - ((pos - s_idx) % win)  # (win,)
+                    mask = (held >= 0)[None, :]  # (1, win)
+                else:
+                    # Multi-token (prefill) step: attend over [cache,
+                    # this step's keys] with EXPLICIT absolute
+                    # positions — same-step rows never overwrite keys
+                    # a same-step query still needs. Slot s holds the
+                    # latest position <= pos-1 congruent to s
+                    # (negative = never written).
+                    held = pos - 1 - ((pos - 1 - s_idx) % win)  # (win,)
+                    k_att = jnp.concatenate([k_cache, k], axis=2)
+                    v_att = jnp.concatenate([v_cache, v], axis=2)
+                    kpos = jnp.concatenate([held, pos + jnp.arange(t)])
+                    qpos = pos + jnp.arange(t)[:, None]  # (T, 1)
+                    mask = (
+                        (kpos[None, :] <= qpos)
+                        & (kpos[None, :] > qpos - win)
+                        & (kpos[None, :] >= 0)
+                    )  # (T, win+T)
+                    k_cache = k_cache.at[:, :, slots, :].set(k)
+                    v_cache = v_cache.at[:, :, slots, :].set(v)
         else:
             # Write the T new K/V rows at the cache head.
             if per_slot:

@@ -164,7 +164,11 @@ class DecodeServer:
                 f"num_steps={num_steps}: need at least one generated "
                 "token (a non-positive count would never complete)"
             )
-        if self.prefix_len + t0 + num_steps > self.dec.cfg.max_len:
+        if (
+            not getattr(self.dec, "rolling_cache", False)
+            and self.prefix_len + t0 + num_steps > self.dec.cfg.max_len
+        ):
+            # Rolling caches have no length bound — slots recycle.
             raise ValueError(
                 f"prefix {self.prefix_len} + prompt {t0} + steps "
                 f"{num_steps} exceeds max_len {self.dec.cfg.max_len}"
@@ -192,9 +196,35 @@ class DecodeServer:
             rid, prompt, steps, adapter_id = self.pending.pop(0)
             t0 = prompt.shape[1]
             P = self.prefix_len
+            rolling = getattr(self.dec, "rolling_cache", False)
+            win = self.dec.cfg.window if rolling else None
+            if rolling and t0 > win:
+                # Longer-than-window prompt: window-chunked rolling
+                # prefill (fixed window pieces + at most `win` distinct
+                # tail shapes — bounded compile set; padding a rolling
+                # step on a WARM cache would evict live slots).
+                small = self.dec.init_cache(1)
+                if self.multi_lora:
+                    small["adapter"] = jnp.full(
+                        (1,), adapter_id, jnp.int32
+                    )
+                last, small = self.dec.prefill(
+                    self.params, small, prompt, chunk=win
+                )
+                first = jnp.argmax(last, axis=-1)[:, None].astype(
+                    prompt.dtype
+                )
+                self._install_lane(
+                    i, slot, rid, steps, prompt, small, first,
+                    t0, adapter_id,
+                )
+                continue
             # Bucketed prefill keeps the compiled-shape set small.
+            # Rolling admission always starts from a FRESH lane, so
+            # padded rows sit at held < 0 (masked) and the window caps
+            # the bucket instead of max_len.
             pad = 1 << (t0 - 1).bit_length()
-            pad = min(pad, self.dec.cfg.max_len - P)
+            pad = min(pad, win if rolling else self.dec.cfg.max_len - P)
             padded = jnp.concatenate(
                 [prompt, jnp.zeros((1, pad - t0), prompt.dtype)], axis=1
             )
@@ -210,35 +240,46 @@ class DecodeServer:
             if self.multi_lora:
                 small["adapter"] = jnp.full((1,), adapter_id, jnp.int32)
             logits, small = self.step(self.params, small, padded)
-            # Insert the lane: K/V rows land in slot i; rows past
-            # P + t0 are stale but position-masked until overwritten.
-            new_cache = {
-                "k": jax.lax.dynamic_update_slice(
-                    self.cache["k"], small["k"], (0, i, 0, 0, 0)
-                ),
-                "v": jax.lax.dynamic_update_slice(
-                    self.cache["v"], small["v"], (0, i, 0, 0, 0)
-                ),
-                "pos": self.cache["pos"].at[i].set(P + t0),
-            }
-            if self.multi_lora:
-                new_cache["adapter"] = (
-                    self.cache["adapter"].at[i].set(adapter_id)
-                )
-            self.cache = new_cache
             first = jnp.argmax(logits[:, t0 - 1, :], axis=-1)[
                 :, None
             ].astype(prompt.dtype)
-            slot.req = rid
-            slot.remaining = steps - 1
-            slot.last = first
-            slot.toks = [prompt, first]
-            if self.eos_id is not None and int(first[0, 0]) == self.eos_id:
-                slot.remaining = 0
-            if self.on_token is not None:
-                self.on_token(rid, int(first[0, 0]), slot.remaining == 0)
-            if slot.remaining == 0:
-                self._finish(slot)
+            self._install_lane(
+                i, slot, rid, steps, prompt, small, first,
+                P + t0, adapter_id,
+            )
+
+    def _install_lane(
+        self, i, slot, rid, steps, prompt, small, first, pos_val,
+        adapter_id,
+    ) -> None:
+        """The one admission tail both prefill paths share: insert the
+        prefilled lane into slot i (rows past pos_val are stale but
+        position-masked until overwritten), set per-slot state, and
+        run the eos/streaming/finish bookkeeping."""
+        new_cache = {
+            "k": jax.lax.dynamic_update_slice(
+                self.cache["k"], small["k"], (0, i, 0, 0, 0)
+            ),
+            "v": jax.lax.dynamic_update_slice(
+                self.cache["v"], small["v"], (0, i, 0, 0, 0)
+            ),
+            "pos": self.cache["pos"].at[i].set(pos_val),
+        }
+        if self.multi_lora:
+            new_cache["adapter"] = (
+                self.cache["adapter"].at[i].set(adapter_id)
+            )
+        self.cache = new_cache
+        slot.req = rid
+        slot.remaining = steps - 1
+        slot.last = first
+        slot.toks = [prompt, first]
+        if self.eos_id is not None and int(first[0, 0]) == self.eos_id:
+            slot.remaining = 0
+        if self.on_token is not None:
+            self.on_token(rid, int(first[0, 0]), slot.remaining == 0)
+        if slot.remaining == 0:
+            self._finish(slot)
 
     def _tick(self) -> None:
         active = [s.req is not None for s in self.slots]

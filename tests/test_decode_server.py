@@ -123,6 +123,40 @@ def test_eos_frees_slots_early():
     assert stopped_early  # the chosen eos fired for at least one req
 
 
+def test_rolling_cache_server_matches_solo():
+    """Sliding-window (Mistral-family) serving: per-slot rolling
+    caches — each slot's write recycles ITS OWN window — match solo
+    rolling decodes exactly, for prompts shorter AND longer than the
+    window and generation that crosses the window boundary."""
+    from defer_tpu.models.gpt import GptDecoder
+    from defer_tpu.models.llama import mistral_config
+
+    cfg = mistral_config(
+        num_layers=2, dim=32, num_heads=4, num_kv_heads=2,
+        ffn_dim=64, vocab_size=64, max_len=64, window=8,
+    )
+    dec = GptDecoder(cfg, rolling_cache=True, compute_dtype=jnp.float32)
+    params = dec.init(jax.random.key(0))
+    reqs = [
+        (jnp.asarray([[3, 9, 27]], jnp.int32), 12),  # crosses window
+        (jnp.asarray([[5]], jnp.int32), 4),
+        # Prompt longer than the window: chunked rolling prefill.
+        (
+            jax.random.randint(jax.random.key(1), (1, 13), 0, 64),
+            6,
+        ),
+        (jnp.asarray([[4, 4]], jnp.int32), 9),
+    ]
+    outs, stats = serve_greedy(dec, params, reqs, max_batch=2)
+    for (p, s), got in zip(reqs, outs):
+        want = dec.generate(params, p, s)
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(want),
+            err_msg=f"prompt len {p.shape[1]} steps {s}",
+        )
+    assert stats["ticks"] > 0
+
+
 def test_streaming_callback_matches_outputs():
     """on_token streams every generated token in order, with done=True
     exactly once per request, and the streamed sequence equals the
