@@ -59,7 +59,10 @@ class PagedDecodeServer:
         block_size: int = 16,
         max_batch: int = 4,
         eos_id: int | None = None,
+        on_token: Any = None,
     ):
+        """`on_token(request_id, token_id, done)` — optional streaming
+        callback, same contract as the flat server's."""
         if getattr(dec, "rolling_cache", False):
             raise ValueError("paged serving does not support rolling caches")
         # Multi-LoRA: adapter banks (parallel/lora.py::stack_adapters)
@@ -81,6 +84,7 @@ class PagedDecodeServer:
         self.B = max_batch
         self.bs = block_size
         self.eos_id = eos_id
+        self.on_token = on_token
         cfg = dec.cfg
         # Max logical blocks any sequence can span.
         self.MB = -(-cfg.max_len // block_size)
@@ -309,13 +313,7 @@ class PagedDecodeServer:
                 "blocks": blocks,
             }
             self.slots[i] = slot
-            if (
-                self.eos_id is not None
-                and int(first[0, 0]) == self.eos_id
-            ):
-                slot["remaining"] = 0
-            if slot["remaining"] == 0:
-                self._finish(i)
+            self._emit_token(i, slot, int(first[0, 0]))
 
     def _tick(self) -> None:
         live = [s is not None for s in self.slots]
@@ -344,9 +342,10 @@ class PagedDecodeServer:
         )
         self.ticks += 1
         nxt = jnp.argmax(logits[:, -1, :], axis=-1)
-        # Host transfer only when eos detection needs the values —
-        # the no-eos path stays async (same guard as the flat server).
-        host_nxt = np.asarray(nxt) if self.eos_id is not None else None
+        # Host transfer only when eos/streaming needs the values —
+        # the plain path stays async (same guard as the flat server).
+        need_host = self.eos_id is not None or self.on_token is not None
+        host_nxt = np.asarray(nxt) if need_host else None
         for i, slot in enumerate(self.slots):
             if slot is None:
                 continue
@@ -355,13 +354,25 @@ class PagedDecodeServer:
             slot["toks"].append(tok)
             slot["remaining"] -= 1
             self.pos[i] += 1
-            if (
-                self.eos_id is not None
-                and int(host_nxt[i]) == self.eos_id
-            ):
-                slot["remaining"] = 0
-            if slot["remaining"] == 0:
-                self._finish(i)
+            self._emit_token(
+                i, slot, int(host_nxt[i]) if host_nxt is not None else None
+            )
+
+    def _emit_token(self, i: int, slot: dict, tok: int | None) -> None:
+        """Shared eos/streaming/finish bookkeeping for one emitted
+        token (admission first-token and every tick): `tok` is the
+        host-side token value, or None when neither eos nor streaming
+        needed the transfer."""
+        if (
+            self.eos_id is not None
+            and tok is not None
+            and tok == self.eos_id
+        ):
+            slot["remaining"] = 0
+        if self.on_token is not None:
+            self.on_token(slot["rid"], tok, slot["remaining"] == 0)
+        if slot["remaining"] == 0:
+            self._finish(i)
 
     def _finish(self, i: int) -> None:
         slot = self.slots[i]

@@ -97,6 +97,30 @@ def test_eos_frees_blocks_early():
         np.testing.assert_array_equal(got[0], want[0, : got.shape[1]])
 
 
+def test_paged_streaming_callback():
+    """on_token streams every generated token in order with done=True
+    exactly once per request — same contract as the flat server."""
+    dec = tiny_gpt(64)
+    params = dec.init(jax.random.key(0))
+    reqs = _requests(dec.cfg.vocab_size)[:3]
+    streamed: dict[int, list[int]] = {}
+    finals: list[int] = []
+
+    srv = PagedDecodeServer(
+        dec, params, num_blocks=12, block_size=8, max_batch=2,
+        on_token=lambda rid, tok, done: (
+            streamed.setdefault(rid, []).append(tok),
+            finals.append(rid) if done else None,
+        ),
+    )
+    rids = [srv.submit(p, s) for p, s in reqs]
+    done = srv.run()
+    assert sorted(finals) == sorted(rids)
+    for (p, s), rid in zip(reqs, rids):
+        gen = np.asarray(done[rid])[0, p.shape[1]:]
+        assert streamed[rid] == gen.tolist() and len(streamed[rid]) == s
+
+
 def test_paged_validation():
     dec = tiny_gpt(32)
     params = dec.init(jax.random.key(0))
