@@ -60,9 +60,18 @@ class PagedDecodeServer:
         max_batch: int = 4,
         eos_id: int | None = None,
         on_token: Any = None,
+        prefix_ids: jax.Array | None = None,
     ):
         """`on_token(request_id, token_id, done)` — optional streaming
-        callback, same contract as the flat server's."""
+        callback, same contract as the flat server's.
+
+        `prefix_ids` [1, P] — SHARED-prefix paging: the system
+        prompt's K/V blocks are allocated ONCE and every request's
+        block table points at them (the flat server copies the prefix
+        lane per admission; here the pool holds one copy, period).
+        Requires P to be a block_size multiple so suffix writes can
+        never touch a shared block. Admissions prefill only the
+        suffix."""
         if getattr(dec, "rolling_cache", False):
             raise ValueError("paged serving does not support rolling caches")
         # Multi-LoRA: adapter banks (parallel/lora.py::stack_adapters)
@@ -107,6 +116,67 @@ class PagedDecodeServer:
         self.blocks_peak = 0
         self._step = None
         self._insert = None
+        self.prefix_len = 0
+        self.shared_blocks: list[int] = []
+        self._prefix_cache = None
+        if prefix_ids is not None:
+            if self.multi_lora:
+                raise ValueError(
+                    "prefix caching + multi-LoRA is unsupported: the "
+                    "shared prefix K/V would be adapter-dependent"
+                )
+            if prefix_ids.ndim != 2 or prefix_ids.shape[0] != 1:
+                raise ValueError("prefix_ids must be [1, P]")
+            P = int(prefix_ids.shape[1])
+            if P % block_size:
+                raise ValueError(
+                    f"shared-prefix paging needs the prefix length "
+                    f"({P}) to be a block_size ({block_size}) multiple "
+                    "— otherwise a suffix write would land in a "
+                    "SHARED block and corrupt every other request"
+                )
+            if P >= cfg.max_len:
+                raise ValueError(
+                    f"prefix of {P} leaves no room under max_len "
+                    f"{cfg.max_len}"
+                )
+            n_shared = P // block_size
+            if n_shared > len(self.free):
+                raise ValueError(
+                    f"prefix needs {n_shared} blocks but the pool has "
+                    f"{len(self.free)} usable"
+                )
+            # One prefix prefill through the flat path; its rows
+            # become the pool's single shared copy (a skip-0 insert:
+            # admissions later use a skip=n_shared insert that can
+            # never write the shared blocks).
+            from defer_tpu.utils.memo import cached_step
+
+            full_insert = cached_step(
+                dec,
+                ("paged_insert", block_size, 0),
+                lambda: self._build_insert(0),
+            )
+            pre = dec.init_cache(1)
+            _, pre = dec.make_step()(params, pre, prefix_ids)
+            self.shared_blocks = [
+                self.free.pop() for _ in range(n_shared)
+            ]
+            shared_row = np.zeros((self.MB,), np.int32)
+            for j, blk in enumerate(self.shared_blocks):
+                shared_row[j] = blk
+            self.pool_k, self.pool_v = full_insert(
+                self.pool_k,
+                self.pool_v,
+                pre["k"],
+                pre["v"],
+                jnp.asarray(shared_row),
+            )
+            # Keep the contiguous prefix lane for suffix admissions
+            # (the suffix prefill needs the prefix rows in the flat
+            # layout to attend at offset P).
+            self._prefix_cache = pre
+            self.prefix_len = P
 
     # -- public API -------------------------------------------------------
 
@@ -133,23 +203,30 @@ class PagedDecodeServer:
         t0 = prompt_ids.shape[1]
         if t0 < 1 or num_steps < 1:
             raise ValueError("need at least 1 prompt token and 1 step")
-        if t0 + num_steps > self.dec.cfg.max_len:
+        if self.prefix_len + t0 + num_steps > self.dec.cfg.max_len:
             raise ValueError(
-                f"prompt {t0} + steps {num_steps} exceeds max_len "
-                f"{self.dec.cfg.max_len}"
+                f"prefix {self.prefix_len} + prompt {t0} + steps "
+                f"{num_steps} exceeds max_len {self.dec.cfg.max_len}"
             )
-        need = -(-(t0 + num_steps) // self.bs)
-        if need > self.pool_k.shape[1] - 1:
+        need = self._own_need(t0, num_steps)
+        usable = self.pool_k.shape[1] - 1 - len(self.shared_blocks)
+        if need > usable:
             # Not even an empty pool could hold it — waiting would
             # deadlock the queue.
             raise ValueError(
-                f"request needs {need} blocks but the pool has "
-                f"{self.pool_k.shape[1] - 1} usable"
+                f"request needs {need} own blocks but the pool has "
+                f"{usable} usable beyond the shared prefix"
             )
         rid = self._next_id
         self._next_id += 1
         self.pending.append((rid, prompt_ids, num_steps, adapter_id))
         return rid
+
+    def _own_need(self, t0: int, steps: int) -> int:
+        """Blocks a request must own: its total span minus the shared
+        prefix blocks its table merely points at."""
+        total = -(-(self.prefix_len + t0 + steps) // self.bs)
+        return total - len(self.shared_blocks)
 
     def run(self) -> dict[int, jax.Array]:
         while self.pending or any(self.slots):
@@ -175,8 +252,11 @@ class PagedDecodeServer:
         self._step = cached_step(
             self.dec, ("paged_step", self.bs), self._build_step
         )
+        skip = len(self.shared_blocks)
         self._insert = cached_step(
-            self.dec, ("paged_insert", self.bs), self._build_insert
+            self.dec,
+            ("paged_insert", self.bs, skip),
+            lambda: self._build_insert(skip),
         )
 
     def _build_step(self):
@@ -221,7 +301,7 @@ class PagedDecodeServer:
 
         return jax.jit(step, donate_argnums=(1, 2))
 
-    def _build_insert(self):
+    def _build_insert(self, skip: int = 0):
         bs = self.bs
 
         def insert(pk, pv, small_k, small_v, table_row):
@@ -254,8 +334,12 @@ class PagedDecodeServer:
             v_blocks = v_rows.reshape(L, hkv, mb, bs, dh).transpose(
                 0, 2, 1, 3, 4
             )
-            pk = pk.at[:, table_row].set(k_blocks)
-            pv = pv.at[:, table_row].set(v_blocks)
+            # skip > 0 = shared-prefix mode: never write the shared
+            # blocks (their rows in the small cache are identical by
+            # construction, but they are not this request's to touch).
+            dest = table_row[skip:]
+            pk = pk.at[:, dest].set(k_blocks[:, skip:])
+            pv = pv.at[:, dest].set(v_blocks[:, skip:])
             return pk, pv
 
         return jax.jit(insert, donate_argnums=(0, 1))
@@ -266,7 +350,9 @@ class PagedDecodeServer:
                 continue
             rid, prompt, steps, adapter_id = self.pending[0]
             t0 = prompt.shape[1]
-            need = -(-(t0 + steps) // self.bs)
+            P = self.prefix_len
+            n_shared = len(self.shared_blocks)
+            need = self._own_need(t0, steps)
             if need > len(self.free):
                 return  # pool exhausted: wait for a finisher
             self.pending.pop(0)
@@ -277,21 +363,33 @@ class PagedDecodeServer:
             )
             # Contiguous prefill through the flat decoder — pow2
             # bucketed like the flat server, so the compiled prefill
-            # shape set stays tiny — then page the rows in.
+            # shape set stays tiny — then page the rows in. With a
+            # shared prefix the suffix prefills at offset P on a COPY
+            # of the contiguous prefix lane (the flat step donates its
+            # cache), and only rows past the shared blocks are paged.
             pad = 1 << (t0 - 1).bit_length()
-            pad = min(pad, self.dec.cfg.max_len)
+            pad = min(pad, self.dec.cfg.max_len - P)
             padded = jnp.concatenate(
                 [prompt, jnp.zeros((1, pad - t0), prompt.dtype)], axis=1
             )
-            small = self.dec.init_cache(1)
+            # Non-donating prefill step: the master prefix lane is
+            # read directly (no per-admission deep copy of two full
+            # max_len K/V buffers — the cost this feature exists to
+            # avoid); the returned cache is a fresh tree.
+            if self._prefix_cache is None:
+                small = self.dec.init_cache(1)
+            else:
+                small = dict(self._prefix_cache)
             if self.multi_lora:
                 small["adapter"] = jnp.full((1,), adapter_id, jnp.int32)
-            logits, small = self.dec.make_step()(
+            logits, small = self.dec.make_step(donate=False)(
                 self.params, small, padded
             )
             table_row = np.zeros((self.MB,), np.int32)
-            for j, blk in enumerate(blocks):
+            for j, blk in enumerate(self.shared_blocks):
                 table_row[j] = blk
+            for j, blk in enumerate(blocks):
+                table_row[n_shared + j] = blk
             self.pool_k, self.pool_v = self._insert(
                 self.pool_k,
                 self.pool_v,
@@ -303,7 +401,7 @@ class PagedDecodeServer:
                 :, None
             ].astype(prompt.dtype)
             self.tables[i] = table_row
-            self.pos[i] = t0
+            self.pos[i] = P + t0
             self.adapter[i] = adapter_id
             slot = {
                 "rid": rid,
@@ -331,14 +429,19 @@ class PagedDecodeServer:
         pos = jnp.asarray(
             np.where(live, self.pos, 0).astype(np.int32)
         )
+        # COPY the mutable host state before handing it to the device:
+        # jnp.asarray of a numpy array is zero-copy on CPU, and the
+        # host loop mutates tables/adapter in place (finish/admission)
+        # while the async-dispatched step may still be reading them —
+        # the aliasing race corrupts first-execution results.
         logits, self.pool_k, self.pool_v = self._step(
             self.params,
             self.pool_k,
             self.pool_v,
-            jnp.asarray(self.tables),
+            jnp.asarray(self.tables.copy()),
             pos,
             feed,
-            jnp.asarray(self.adapter),
+            jnp.asarray(self.adapter.copy()),
         )
         self.ticks += 1
         nxt = jnp.argmax(logits[:, -1, :], axis=-1)
@@ -394,6 +497,7 @@ def serve_paged(
     max_batch: int = 4,
     eos_id: int | None = None,
     adapter_ids: list | None = None,
+    prefix_ids: jax.Array | None = None,
 ) -> tuple[list[jax.Array], dict]:
     """One-shot paged serving; returns (outputs in submission order,
     stats incl. peak pool usage). `adapter_ids` optionally assigns a
@@ -405,6 +509,7 @@ def serve_paged(
         block_size=block_size,
         max_batch=max_batch,
         eos_id=eos_id,
+        prefix_ids=prefix_ids,
     )
     aids = adapter_ids or [0] * len(requests)
     if len(aids) != len(requests):
@@ -423,5 +528,6 @@ def serve_paged(
         "pool_blocks": int(srv.pool_k.shape[1]) - 1,
         "block_size": block_size,
         "flat_equivalent_rows": max_batch * dec.cfg.max_len,
+        "shared_prefix_blocks": len(srv.shared_blocks),
     }
     return [done[r] for r in rids], stats

@@ -97,6 +97,66 @@ def test_eos_frees_blocks_early():
         np.testing.assert_array_equal(got[0], want[0, : got.shape[1]])
 
 
+@pytest.mark.parametrize("family", ["gpt", "llama"])
+def test_shared_prefix_paging_matches_solo(family):
+    """TRUE prefix sharing: the system prompt's blocks exist once in
+    the pool and every table points at them — each served suffix +
+    generation equals solo-decoding the concatenated ids, for both
+    position styles."""
+    dec = tiny_gpt(64) if family == "gpt" else tiny_llama(64)
+    params = dec.init(jax.random.key(0))
+    prefix = jax.random.randint(jax.random.key(9), (1, 8), 0, 64)
+    reqs = _requests(dec.cfg.vocab_size)[:4]
+    outs, stats = serve_paged(
+        dec, params, reqs, num_blocks=14, block_size=4, max_batch=2,
+        prefix_ids=prefix,
+    )
+    assert stats["shared_prefix_blocks"] == 2  # 8 tokens / 4-row blocks
+    for (sfx, steps), got in zip(reqs, outs):
+        full = jnp.concatenate([prefix, sfx], axis=1)
+        want = dec.generate(params, full, steps)[:, prefix.shape[1]:]
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(want),
+            err_msg=f"{family} suffix={np.asarray(sfx)} steps={steps}",
+        )
+
+
+def test_shared_prefix_blocks_are_never_rewritten():
+    """The shared blocks' contents are bit-identical before and after
+    serving a full workload — admissions write only owned blocks."""
+    dec = tiny_gpt(64)
+    params = dec.init(jax.random.key(0))
+    prefix = jax.random.randint(jax.random.key(9), (1, 8), 0, 64)
+    srv = PagedDecodeServer(
+        dec, params, num_blocks=14, block_size=4, max_batch=2,
+        prefix_ids=prefix,
+    )
+    shared = list(srv.shared_blocks)
+    before_k = np.asarray(srv.pool_k[:, shared])
+    for p, s in _requests(64)[:3]:
+        srv.submit(p, s)
+    srv.run()
+    np.testing.assert_array_equal(
+        np.asarray(srv.pool_k[:, shared]), before_k
+    )
+
+
+def test_shared_prefix_validation():
+    dec = tiny_gpt(64)
+    params = dec.init(jax.random.key(0))
+    with pytest.raises(ValueError, match="multiple"):
+        PagedDecodeServer(
+            dec, params, num_blocks=8, block_size=4,
+            prefix_ids=jnp.zeros((1, 6), jnp.int32),  # 6 % 4 != 0
+        )
+    srv = PagedDecodeServer(
+        dec, params, num_blocks=8, block_size=4,
+        prefix_ids=jnp.zeros((1, 8), jnp.int32),
+    )
+    with pytest.raises(ValueError, match="max_len"):
+        srv.submit(jnp.zeros((1, 30), jnp.int32), 30)  # 8+30+30 > 64
+
+
 def test_paged_streaming_callback():
     """on_token streams every generated token in order with done=True
     exactly once per request — same contract as the flat server."""
