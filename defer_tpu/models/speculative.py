@@ -15,8 +15,9 @@ TPU-shaped mechanics on the existing KV-cache decoder:
     absolute position (gpt.py _block), so stale K/V rows beyond `pos`
     are never attended and the next write overwrites them — rollback
     costs a scalar update, no buffer copies;
-  * the compiled step set is small and reused: T=1 (draft), T=k /
-    T=k+1 (verify with/without a pending token), T=prompt (prefill).
+  * the compiled step set is small and reused: T=1 / T=2 (draft — two
+    tokens pend after a full-accept round's bonus token), T=k / T=k+1
+    (verify with/without a pending token), T=prompt (prefill).
 
 The reference has no serving stack at all (it streams CNN frames,
 reference src/test.py:30-41); this joins the beyond-reference serving
@@ -71,8 +72,10 @@ def speculative_generate(
 
     Invariant kept across rounds: the target cache covers `ids` except
     at most one trailing token; the draft cache covers `ids` except
-    EXACTLY one trailing token (so each proposal round starts by
-    feeding that token and reading the draft's next-token logits).
+    one trailing token — two right after a full-accept round, whose
+    bonus token (sampled free from the verify forward's final logits)
+    is never fed to either model in-round. Each proposal round starts
+    by feeding the draft whatever it is missing.
     """
     if prompt_ids.shape[0] != 1:
         raise ValueError("speculative decoding is batch-1 (scalar rewind)")
@@ -136,10 +139,14 @@ def speculative_generate(
 
     while ids.shape[1] - t0 < num_steps:
         n0 = ids.shape[1]
-        # 1. Draft proposes k tokens, starting from its missing last
-        #    accepted token (greedy argmax, or samples from q with the
-        #    per-position distributions kept for the accept test).
-        feed = ids[:, -1:]
+        # 1. Draft proposes k tokens, starting from the tokens it has
+        #    not yet seen — one normally, two after a full-accept round
+        #    (the bonus token was never fed). Greedy argmax, or samples
+        #    from q with the per-position distributions kept for the
+        #    accept test.
+        d_pos = int(jax.device_get(dcache["pos"]))
+        assert n0 - d_pos in (1, 2), (n0, d_pos)
+        feed = ids[:, d_pos:]
         proposals = []
         q_dists = []
         for _ in range(k):
@@ -237,10 +244,23 @@ def speculative_generate(
         accepted_total += a
 
         if a == k:
-            new = prop
-            # Bonus: the verify forward already predicts the token
-            # after p_k.
-            last_logits = vlogits[:, t_missing + k - 1, :]
+            # Bonus token (Leviathan/Chen): the verify forward's final
+            # logits already predict the token after p_k — emitting it
+            # is free, making every verify forward worth a+1 tokens on
+            # full-accept rounds too. It has not been fed to either
+            # model, so the target pends it (t_missing=1 next round)
+            # and the draft starts two behind.
+            fin = vlogits[:, t_missing + k - 1, :]
+            if sampled:
+                rng, sub_b = jax.random.split(rng)
+                bonus = jax.random.categorical(
+                    sub_b, filt(fin), axis=-1
+                )[:, None].astype(ids.dtype)
+            else:
+                bonus = jnp.argmax(fin, axis=-1)[:, None].astype(
+                    ids.dtype
+                )
+            new = jnp.concatenate([prop, bonus], axis=1)
         else:
             # The corrected token (target argmax in greedy mode, the
             # residual sample otherwise) replaces the first rejection;

@@ -174,70 +174,92 @@ def _flash_bwd(causal, interpret, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _decode_lo_hi(p_b, block_k: int, window: int | None):
+    """First/last LIVE K-block (inclusive) for a sequence whose last
+    valid key is `p_b`: blocks wholly outside [pos-window+1, pos] are
+    dead. Shared by the kernel's compute gate and the index maps'
+    DMA-clamping so the two can never disagree."""
+    hi = p_b // block_k
+    lo = (
+        jnp.maximum(p_b - window + 1, 0) // block_k
+        if window is not None
+        else jnp.int32(0)
+    )
+    return lo, hi
+
+
 def _decode_kernel(
     pos_ref,
     q_ref,
     k_ref,
     v_ref,
     o_ref,
+    m_scr,
+    l_scr,
+    acc_scr,
     *,
     sm_scale: float,
     block_k: int,
     window: int | None,
+    num_kb: int,
 ):
-    """One (batch, kv-head) cell: the query GROUP (G rows sharing this
-    KV head — GQA) attends the cache with the online-softmax
-    recurrence, streaming K/V blocks through VMEM. `pos` is the index
-    of the LAST valid key (inclusive); the loop bounds skip blocks
-    wholly outside [pos-window+1, pos], so decode reads O(live rows),
-    not O(max_len)."""
-    q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # (G, d)
-    g = q.shape[0]
-    p_b = pos_ref[0, 0]
+    """One (batch, kv-head, k-block) cell: the query GROUP (G rows
+    sharing this KV head — GQA) folds one block_k-row K/V tile into the
+    online-softmax carry held in VMEM scratch (the k-block axis is the
+    innermost grid dim, so scratch persists across it per (batch,
+    head)). VMEM residency is O(block_k), not O(max_len): the index
+    maps stage only this cell's tile. Dead blocks — wholly outside
+    [pos-window+1, pos] — are compute-gated off here AND clamped to a
+    live block index in the index maps, so revisiting the same tile
+    issues no new DMA; decode stays O(live rows) in both bandwidth and
+    compute."""
+    kb = pl.program_id(2)
+    p_b = pos_ref[pl.program_id(0)]
+    lo, hi = _decode_lo_hi(p_b, block_k, window)
 
-    def body(i, carry):
-        m, l, acc = carry
-        k = k_ref[0, 0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, 0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[:] = jnp.full(m_scr.shape, _MASK_VALUE, jnp.float32)
+        l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    @pl.when((kb >= lo) & (kb <= hi))
+    def _fold():
+        q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # (G, d)
+        g = q.shape[0]
+        k = k_ref[0, 0].astype(jnp.float32)  # (block_k, d)
+        v = v_ref[0, 0].astype(jnp.float32)
         s = lax.dot_general(
             q,
             k,
             (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # (G, block_k)
-        cols = i * block_k + lax.broadcasted_iota(
+        cols = kb * block_k + lax.broadcasted_iota(
             jnp.int32, (g, block_k), 1
         )
         mask = cols <= p_b
         if window is not None:
             mask &= cols > p_b - window
         s = jnp.where(mask, s, _MASK_VALUE)
+        m = m_scr[:]
         m_new = jnp.maximum(m, s.max(axis=-1))
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new[:, None])
-        l_new = l * alpha + p.sum(axis=-1)
-        acc_new = acc * alpha[:, None] + lax.dot_general(
+        m_scr[:] = m_new
+        l_scr[:] = l_scr[:] * alpha + p.sum(axis=-1)
+        acc_scr[:] = acc_scr[:] * alpha[:, None] + lax.dot_general(
             p,
             v,
             (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return m_new, l_new, acc_new
 
-    # Dynamic trip bounds: only blocks intersecting the live window.
-    hi = p_b // block_k + 1
-    lo = (
-        jnp.maximum(p_b - window + 1, 0) // block_k
-        if window is not None
-        else jnp.int32(0)
-    )
-    init = (
-        jnp.full((g,), _MASK_VALUE, jnp.float32),
-        jnp.zeros((g,), jnp.float32),
-        jnp.zeros((g, q.shape[1]), jnp.float32),
-    )
-    _, l, acc = lax.fori_loop(lo, hi, body, init)
-    o_ref[0, 0] = (acc / l[:, None]).astype(o_ref.dtype)
+    @pl.when(kb == num_kb - 1)
+    def _emit():
+        o_ref[0, 0] = (acc_scr[:] / l_scr[:][:, None]).astype(
+            o_ref.dtype
+        )
 
 
 def flash_decode(
@@ -264,7 +286,10 @@ def flash_decode(
 
     Query groups narrower than 8 rows are zero-padded to the TPU
     sublane tile and sliced back (padded rows attend garbage that is
-    discarded). The position scalar rides a (B, 1) VMEM tile.
+    discarded). Positions ride scalar prefetch (SMEM): the K-block
+    index maps read them to clamp dead blocks onto a live tile, so
+    only O(block_k) K/V rows are ever VMEM-resident and dead grid
+    cells issue no DMA.
     """
     b, hq, d = q.shape
     _, hkv, s, _ = k.shape
@@ -274,32 +299,51 @@ def flash_decode(
     bk = _pick_block(s, block_k)
     if bk < 8:
         raise ValueError(f"no tile-friendly K block for cache len {s}")
+    num_kb = s // bk
     g_pad = max(g, 8)
     qg = q.reshape(b, hkv, g, d)
     if g_pad != g:
         qg = jnp.pad(qg, ((0, 0), (0, 0), (0, g_pad - g), (0, 0)))
-    pos2 = jnp.broadcast_to(
-        jnp.asarray(pos, jnp.int32).reshape(-1, 1), (b, 1)
-    )
+    pos1 = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1), (b,))
     kernel = functools.partial(
         _decode_kernel,
         sm_scale=d**-0.5,
         block_k=bk,
         window=window,
+        num_kb=num_kb,
+    )
+
+    def kv_index(i, j, kb, pos_ref):
+        lo, hi = _decode_lo_hi(pos_ref[i], bk, window)
+        return (i, j, jnp.clip(kb, lo, hi), 0)
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, hkv, num_kb),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, g_pad, d), lambda i, j, kb, pos_ref: (i, j, 0, 0)
+            ),
+            pl.BlockSpec((1, 1, bk, d), kv_index),
+            pl.BlockSpec((1, 1, bk, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, g_pad, d), lambda i, j, kb, pos_ref: (i, j, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((g_pad,), jnp.float32),
+            pltpu.VMEM((g_pad,), jnp.float32),
+            pltpu.VMEM((g_pad, d), jnp.float32),
+        ],
     )
     out = pl.pallas_call(
         kernel,
-        grid=(b, hkv),
-        in_specs=[
-            pl.BlockSpec((1, 1), lambda i, j: (i, 0)),
-            pl.BlockSpec((1, 1, g_pad, d), lambda i, j: (i, j, 0, 0)),
-            pl.BlockSpec((1, 1, s, d), lambda i, j: (i, j, 0, 0)),
-            pl.BlockSpec((1, 1, s, d), lambda i, j: (i, j, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, 1, g_pad, d), lambda i, j: (i, j, 0, 0)),
+        grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, hkv, g_pad, d), q.dtype),
         interpret=interpret,
-    )(pos2, qg, k, v)
+    )(pos1, qg, k, v)
     return out[:, :, :g, :].reshape(b, hq, d)
 
 

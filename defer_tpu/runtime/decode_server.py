@@ -230,16 +230,22 @@ class DecodeServer:
             )
             if self._prefix_cache is None:
                 small = self.dec.init_cache(1)
+                if self.multi_lora:
+                    small["adapter"] = jnp.full(
+                        (1,), adapter_id, jnp.int32
+                    )
+                logits, small = self.step(self.params, small, padded)
             else:
-                # Copy the shared-prefix lane (self.step donates its
-                # cache argument, so the master copy must not be
-                # handed over). The suffix then prefills at offset P.
-                small = jax.tree_util.tree_map(
-                    jnp.array, self._prefix_cache
+                # Suffix prefill through a NON-donating step: the
+                # master prefix lane is read in place (no per-admission
+                # deep copy of two [L, 1, Hkv, max_len, Dh] buffers —
+                # the cost prefix caching exists to avoid) and the
+                # returned cache is a fresh tree. (prefix caching +
+                # multi-LoRA is rejected at construction.)
+                small = dict(self._prefix_cache)
+                logits, small = self.dec.make_step(donate=False)(
+                    self.params, small, padded
                 )
-            if self.multi_lora:
-                small["adapter"] = jnp.full((1,), adapter_id, jnp.int32)
-            logits, small = self.step(self.params, small, padded)
             first = jnp.argmax(logits[:, t0 - 1, :], axis=-1)[
                 :, None
             ].astype(prompt.dtype)

@@ -411,7 +411,14 @@ class PagedDecodeServer:
                 "blocks": blocks,
             }
             self.slots[i] = slot
-            self._emit_token(i, slot, int(first[0, 0]))
+            # Host transfer only when eos/streaming consumes the value
+            # (same guard as _tick) — the plain path stays async.
+            need_host = (
+                self.eos_id is not None or self.on_token is not None
+            )
+            self._emit_token(
+                i, slot, int(first[0, 0]) if need_host else None
+            )
 
     def _tick(self) -> None:
         live = [s is not None for s in self.slots]

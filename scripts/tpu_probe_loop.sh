@@ -1,0 +1,49 @@
+#!/bin/bash
+# Background TPU-evidence capture loop (VERDICT r4 item #1).
+# Retries a cheap TPU probe; on success runs bench.py and stamps
+# BENCH_TPU_LKG.json with git sha + timestamp. Exits after first success.
+cd /root/repo
+for i in $(seq 1 60); do
+  echo "[probe $i] $(date -u +%FT%TZ)" >> /tmp/tpu_probe.log
+  if timeout 90 python - <<'EOF' >> /tmp/tpu_probe.log 2>&1
+import os
+os.environ['JAX_PLATFORMS'] = 'tpu'
+import jax
+d = jax.devices()[0]
+assert d.platform == 'tpu', d.platform
+import jax.numpy as jnp
+x = jnp.ones((128, 128))
+(x @ x).block_until_ready()
+print('TPU OK:', d)
+EOF
+  then
+    echo "[probe $i] TPU alive — running bench" >> /tmp/tpu_probe.log
+    if DEFER_BENCH_NO_FALLBACK=1 timeout 2400 python bench.py \
+        > /tmp/bench_tpu_try.out 2>> /tmp/tpu_probe.log; then
+      python - <<'EOF' > /tmp/tpu_stamp.out 2>&1
+import json, subprocess, datetime
+with open('/tmp/bench_tpu_try.out') as f:
+    lines = [l for l in f.read().strip().splitlines() if l.strip()]
+data = json.loads(lines[-1])
+if data.get('platform') == 'tpu' and data.get('value'):
+    data['git_sha'] = subprocess.check_output(['git', 'rev-parse', 'HEAD'], text=True).strip()
+    data['timestamp'] = datetime.datetime.now(datetime.timezone.utc).isoformat()
+    with open('BENCH_TPU_LKG.json', 'w') as f:
+        json.dump(data, f, indent=1)
+    print('WROTE BENCH_TPU_LKG.json')
+else:
+    print('bench ran but not a TPU result:', data.get('platform'), data.get('value'))
+EOF
+      cat /tmp/tpu_stamp.out >> /tmp/tpu_probe.log
+      # Gate on the stamping step actually writing a FRESH record — a
+      # pre-existing file must not end the loop.
+      if grep -q "WROTE BENCH_TPU_LKG.json" /tmp/tpu_stamp.out; then
+        echo "SUCCESS $(date -u +%FT%TZ)" >> /tmp/tpu_probe.log
+        exit 0
+      fi
+    fi
+  fi
+  sleep 600
+done
+echo "EXHAUSTED $(date -u +%FT%TZ)" >> /tmp/tpu_probe.log
+exit 1
