@@ -29,6 +29,7 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from defer_tpu.parallel.transformer_stack import (
@@ -114,6 +115,106 @@ def truncate_logits(
     return logits
 
 
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling policy for the serving stack
+    (runtime/decode_server.py, runtime/paged.py): the same knobs
+    `generate` takes, plus the seed that makes a server slot reproduce
+    the solo stream exactly. temperature 0 = greedy (filters unused)."""
+
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    min_p: float = 0.0
+    seed: int = 0
+
+    def validate(self) -> None:
+        if self.temperature < 0:
+            raise ValueError(f"temperature {self.temperature} < 0")
+        if self.top_k < 0:
+            raise ValueError(f"top_k {self.top_k} < 0")
+        if not 0 < self.top_p <= 1:
+            raise ValueError(f"top_p {self.top_p} not in (0, 1]")
+        if not 0 <= self.min_p <= 1:
+            raise ValueError(f"min_p {self.min_p} not in [0, 1]")
+
+
+def truncate_logits_batched(
+    logits: jax.Array,
+    top_k: jax.Array,
+    top_p: jax.Array,
+    min_p: jax.Array,
+) -> jax.Array:
+    """truncate_logits with PER-ROW (B,) parameter vectors instead of
+    static scalars — the jitted decode tick of the serving stack runs
+    every slot's policy in one batched pass. Same filters in the same
+    order; a disabled filter (top_k <= 0 or >= V, top_p >= 1,
+    min_p <= 0) reduces to a neutral threshold that compares
+    identically to the skipped branch, so each row's output is
+    BIT-IDENTICAL to truncate_logits on that row with its static
+    params (the serving parity contract)."""
+    neg = jnp.finfo(logits.dtype).min
+    v = logits.shape[-1]
+    # top_k: threshold at the row's k-th highest value (ties survive,
+    # as with lax.top_k); disabled rows threshold at -inf.
+    desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    kth = jnp.take_along_axis(
+        desc, (jnp.clip(top_k, 1, v) - 1)[:, None], axis=-1
+    )
+    kth = jnp.where(
+        ((top_k > 0) & (top_k < v))[:, None], kth, -jnp.inf
+    )
+    logits = jnp.where(logits < kth, neg, logits)
+    # min_p: confidence-scaled floor over the top_k-masked rows
+    # (min_p = 0 -> floor 0, nothing masks).
+    probs = jax.nn.softmax(logits, axis=-1)
+    floor = min_p[:, None] * jnp.max(probs, axis=-1, keepdims=True)
+    logits = jnp.where(probs < floor, neg, logits)
+    # top_p: nucleus over the re-sorted masked rows; disabled rows get
+    # a -inf cutoff (everything survives).
+    desc2 = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs2 = jax.nn.softmax(desc2, axis=-1)
+    cum = jnp.cumsum(probs2, axis=-1)
+    keep = (cum - probs2) < top_p[:, None]
+    keep = keep.at[..., 0].set(True)
+    cutoff = jnp.min(
+        jnp.where(keep, desc2, jnp.inf), axis=-1, keepdims=True
+    )
+    cutoff = jnp.where((top_p < 1.0)[:, None], cutoff, -jnp.inf)
+    return jnp.where(logits < cutoff, neg, logits)
+
+
+@jax.jit
+def sample_token_batched(
+    logits_last: jax.Array,
+    keys: jax.Array,
+    temperature: jax.Array,
+    top_k: jax.Array,
+    top_p: jax.Array,
+    min_p: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """sample_token with per-row (B,) policies and ONE PRNG key per
+    row: each row splits its key exactly once per emitted token — the
+    key schedule solo generate follows — and draws its categorical on
+    the row's filtered logits, so a server slot seeded with
+    jax.random.key(seed) reproduces `generate(..., rng=key(seed))`
+    bit-for-bit. Greedy rows (temperature <= 0) take argmax of the raw
+    logits; their key advances harmlessly (re-seeded at admission).
+    Returns (tokens (B,), advanced keys (B,))."""
+    pair = jax.vmap(lambda kk: jax.random.split(kk, 2))(keys)
+    carry, sub = pair[:, 0], pair[:, 1]
+    greedy = temperature <= 0
+    safe_t = jnp.where(greedy, 1.0, temperature)
+    filtered = truncate_logits_batched(
+        logits_last / safe_t[:, None], top_k, top_p, min_p
+    )
+    sampled = jax.vmap(jax.random.categorical)(sub, filtered)
+    toks = jnp.where(
+        greedy, jnp.argmax(logits_last, axis=-1), sampled
+    )
+    return toks, carry
+
+
 def _flash_decode_mode() -> str | None:
     """Which attention path the T=1 decode step takes: None (the XLA
     einsum — default off-TPU and on tunneled backends), "tpu" (the
@@ -164,6 +265,8 @@ def sampled_decode_loop(
     min_p: float = 0.0,
     rep_penalty: float = 1.0,
     eos_id: int | None = None,
+    stop_sequences=None,
+    pad_id: int | None = None,
     rng: jax.Array | None = None,
 ) -> jax.Array:
     """The one host-side decode loop both decoder families drive
@@ -172,12 +275,35 @@ def sampled_decode_loop(
     eos machinery (pin finished rows, poll-every-K early break, pad
     back to the [B, T + num_steps] shape contract) in a single place.
     The final sampled token needs no forward pass; its logits would
-    never be used."""
+    never be used.
+
+    `stop_sequences` — multi-token stops (runtime/stopping.py): a row
+    whose GENERATED tail completes any sequence stops mid-budget, its
+    output ending with the stop sequence; later positions pin to
+    `pad_id` (defaults to eos_id, else 0). Suffix matching is
+    host-side, so stop-sequence decoding costs one device->host token
+    transfer per step (the eos-only path keeps its poll-every-K
+    run-ahead)."""
     b = ids.shape[0]
     dtype = ids.dtype
     if rng is None:
         rng = jax.random.key(0)
     finished = jnp.zeros((b,), bool) if eos_id is not None else None
+    matchers = None
+    if stop_sequences:
+        from defer_tpu.runtime.stopping import (
+            StopMatcher,
+            normalize_stops,
+        )
+
+        seqs = normalize_stops(stop_sequences)
+        matchers = [StopMatcher(seqs) for _ in range(b)]
+        stopped = np.zeros((b,), bool)
+    pad_tok = (
+        pad_id
+        if pad_id is not None
+        else (eos_id if eos_id is not None else 0)
+    )
     # Presence mask built once from the prompt; each emitted token is
     # a single-element scatter (not a re-scan of the whole sequence).
     seen = None
@@ -193,13 +319,43 @@ def sampled_decode_loop(
         nxt = nxt[:, None].astype(dtype)
         if eos_id is not None:
             nxt, finished = apply_eos(nxt, finished, eos_id)
+        if matchers is not None:
+            if stopped.any():
+                # Rows that already hit a stop sequence emit padding.
+                nxt = jnp.where(
+                    jnp.asarray(stopped)[:, None],
+                    jnp.asarray(pad_tok, dtype),
+                    nxt,
+                )
+            host_nxt = np.asarray(nxt[:, 0])
+            # The per-token host sync is already paid here, so the eos
+            # mask is free every step — it guards the matchers (an
+            # eos-finished row's pinned padding must never stop-match;
+            # matching covers GENERATED tokens only) and breaks the
+            # loop without waiting for the EOS_POLL_EVERY cadence.
+            eos_done = (
+                np.asarray(finished) if eos_id is not None else None
+            )
+            for r in range(b):
+                if stopped[r] or (
+                    eos_done is not None and eos_done[r]
+                ):
+                    continue
+                if matchers[r].push(int(host_nxt[r])):
+                    stopped[r] = True
         if seen is not None:
             seen = seen.at[jnp.arange(b), nxt[:, 0]].set(True)
         ids = jnp.concatenate([ids, nxt], axis=1)
         steps_done = i + 1
-        # Poll the (host-syncing) all-finished check only every
-        # EOS_POLL_EVERY tokens to keep host run-ahead.
-        if (
+        # Early break: the stop path is host-synchronous every step;
+        # the eos-only path keeps its poll-every-K run-ahead.
+        if matchers is not None:
+            done_rows = (
+                stopped if eos_done is None else (stopped | eos_done)
+            )
+            if done_rows.all():
+                break
+        elif (
             eos_id is not None
             and (i + 1) % EOS_POLL_EVERY == 0
             and bool(finished.all())
@@ -209,7 +365,11 @@ def sampled_decode_loop(
             logits, cache = step(params, cache, nxt)
             last = logits[:, -1, :]
     if steps_done < num_steps:
-        pad = jnp.full((b, num_steps - steps_done), eos_id, dtype)
+        pad = jnp.full(
+            (b, num_steps - steps_done),
+            eos_id if eos_id is not None and matchers is None else pad_tok,
+            dtype,
+        )
         ids = jnp.concatenate([ids, pad], axis=1)
     return ids
 
@@ -764,6 +924,8 @@ class GptDecoder:
         min_p: float = 0.0,
         rep_penalty: float = 1.0,
         eos_id: int | None = None,
+        stop_sequences=None,
+        pad_id: int | None = None,
         rng: jax.Array | None = None,
         prefill_chunk: int | None = None,
     ) -> jax.Array:
@@ -807,6 +969,8 @@ class GptDecoder:
             min_p=min_p,
             rep_penalty=rep_penalty,
             eos_id=eos_id,
+            stop_sequences=stop_sequences,
+            pad_id=pad_id,
             rng=rng,
         )
 

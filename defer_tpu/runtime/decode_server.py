@@ -21,9 +21,13 @@ queued request:
     (B, 1) ticks; inactive slots decode a dummy token into row 0 and
     their position is pinned back to 0 after each tick.
 
-Greedy only, and each request's output is BIT-IDENTICAL to a solo
-`dec.generate` of that request at the tested scales — the correctness
-contract the tests pin. (At large widths/vocabs with random weights,
+Greedy by default, per-request sampling on demand: `submit(...,
+sampling=SamplingParams(temperature, top_k, top_p, min_p, seed))`
+routes that slot through a batched in-tick sampler keyed by its OWN
+seeded PRNG stream (SlotSampler), while greedy slots keep the argmax
+fast path. Either way each request's output is BIT-IDENTICAL to a solo
+`dec.generate` of that request (same seed) at the tested scales — the
+correctness contract the tests pin. (At large widths/vocabs with random weights,
 greedy decoding itself is ill-conditioned: near-ties in the softmax
 mean the bucketed/offset prefill's different-but-equivalent reduction
 shapes can flip an argmax; examples/serve_decode.py --check therefore
@@ -50,16 +54,89 @@ import jax.numpy as jnp
 import numpy as np
 
 
+class SlotSampler:
+    """Per-slot sampling state shared by both continuous-batching
+    servers (flat and paged): one PRNG key per slot plus the policy
+    vectors sample_token_batched reads. A slot admitted with
+    SamplingParams draws inside the shared batched tick from its OWN
+    key stream (jax.random.key(seed), one split per emitted token —
+    the schedule solo generate follows), so its output reproduces
+    `generate(..., rng=jax.random.key(seed))` bit-for-bit. Greedy
+    slots keep the argmax fast path."""
+
+    def __init__(self, max_batch: int):
+        self.keys = jax.vmap(jax.random.key)(
+            jnp.zeros((max_batch,), jnp.uint32)
+        )
+        self.temp = jnp.zeros((max_batch,), jnp.float32)
+        self.topk = jnp.zeros((max_batch,), jnp.int32)
+        self.topp = jnp.ones((max_batch,), jnp.float32)
+        self.minp = jnp.zeros((max_batch,), jnp.float32)
+        # Host mirror of `temp`: a greedy admission into a slot a
+        # sampled request vacated must reset that row (a stale
+        # temperature would re-route the greedy slot through the
+        # categorical path).
+        self.row_temp = [0.0] * max_batch
+
+    def admit_first(self, i, samp, logits_row, dtype):
+        """First generated token of an admission [1, 1]: greedy
+        argmax, or the first draw of the request's key stream, with
+        the advanced key and policy installed into slot i's rows."""
+        from defer_tpu.models.gpt import sample_token_batched
+
+        if samp is None:
+            if self.row_temp[i] != 0.0:
+                self.temp = self.temp.at[i].set(0.0)
+                self.row_temp[i] = 0.0
+            return jnp.argmax(logits_row, axis=-1)[:, None].astype(
+                dtype
+            )
+        tok, key1 = sample_token_batched(
+            logits_row,
+            jax.random.key(samp.seed)[None],
+            jnp.full((1,), samp.temperature, jnp.float32),
+            jnp.full((1,), samp.top_k, jnp.int32),
+            jnp.full((1,), samp.top_p, jnp.float32),
+            jnp.full((1,), samp.min_p, jnp.float32),
+        )
+        self.keys = self.keys.at[i].set(key1[0])
+        self.temp = self.temp.at[i].set(samp.temperature)
+        self.topk = self.topk.at[i].set(samp.top_k)
+        self.topp = self.topp.at[i].set(samp.top_p)
+        self.minp = self.minp.at[i].set(samp.min_p)
+        self.row_temp[i] = samp.temperature
+        return tok[:, None].astype(dtype)
+
+    def draw(self, logits_last):
+        """One batched draw over every slot's policy (B,): sampled
+        rows split their own key exactly once, greedy rows reduce to
+        the same argmax as the fast path. Advances the key state."""
+        from defer_tpu.models.gpt import sample_token_batched
+
+        nxt, self.keys = sample_token_batched(
+            logits_last,
+            self.keys,
+            self.temp,
+            self.topk,
+            self.topp,
+            self.minp,
+        )
+        return nxt
+
+
 @dataclasses.dataclass
 class _Slot:
     req: int | None = None
     remaining: int = 0
     last: Any = None  # next token to feed, [1, 1]
     toks: list | None = None
+    sampling: bool = False  # this request runs at temperature > 0
+    stop: Any = None  # per-request StopMatcher (runtime/stopping.py)
 
 
 class DecodeServer:
-    """Greedy continuous-batching decoder over `max_batch` slots."""
+    """Continuous-batching decoder over `max_batch` slots; greedy by
+    default, per-request sampling via `submit(..., sampling=)`."""
 
     def __init__(
         self,
@@ -123,7 +200,8 @@ class DecodeServer:
             _, pre = self.step(params, pre, prefix_ids)
             self._prefix_cache = pre
         self.slots = [_Slot() for _ in range(max_batch)]
-        self.pending: list[tuple[int, jax.Array, int, int]] = []
+        self._sampler = SlotSampler(max_batch)
+        self.pending: list[tuple] = []
         self.done: dict[int, jax.Array] = {}
         self._next_id = 0
         self.ticks = 0
@@ -139,12 +217,30 @@ class DecodeServer:
         num_steps: int,
         *,
         adapter_id: int = 0,
+        sampling: Any = None,
+        stop: Any = None,
     ) -> int:
         """Queue a request; returns its id (resolved in .done).
         `adapter_id` selects the request's LoRA adapter when banks are
-        attached (0 = base model)."""
+        attached (0 = base model). `sampling` — an optional
+        models/gpt.py SamplingParams: the slot then samples inside the
+        shared batched tick with its own temperature/top-k/top-p/min-p
+        and a per-request key, reproducing
+        `generate(..., rng=jax.random.key(seed))` bit-for-bit; None =
+        greedy (the temperature-0 special case). `stop` — optional
+        multi-token stop sequences (iterable of int sequences,
+        runtime/stopping.py): the request finishes the moment its
+        GENERATED tail equals any of them, output ending with the stop
+        sequence — the multi-token generalization of `eos_id`."""
         if prompt_ids.shape[0] != 1:
             raise ValueError("submit one request at a time ([1, T])")
+        if sampling is not None:
+            sampling.validate()
+            if sampling.temperature == 0:
+                sampling = None  # greedy: keep the argmax fast path
+        from defer_tpu.runtime.stopping import normalize_stops
+
+        stop_seqs = normalize_stops(stop)
         if adapter_id:
             if not self.multi_lora:
                 raise ValueError(
@@ -175,7 +271,10 @@ class DecodeServer:
             )
         rid = self._next_id
         self._next_id += 1
-        self.pending.append((rid, prompt_ids, num_steps, adapter_id))
+        self.pending.append(
+            (rid, prompt_ids, num_steps, adapter_id, sampling,
+             stop_seqs)
+        )
         self.solo_steps += num_steps
         return rid
 
@@ -193,7 +292,8 @@ class DecodeServer:
         for i, slot in enumerate(self.slots):
             if slot.req is not None or not self.pending:
                 continue
-            rid, prompt, steps, adapter_id = self.pending.pop(0)
+            (rid, prompt, steps, adapter_id, samp,
+             stop_seqs) = self.pending.pop(0)
             t0 = prompt.shape[1]
             P = self.prefix_len
             rolling = getattr(self.dec, "rolling_cache", False)
@@ -211,12 +311,12 @@ class DecodeServer:
                 last, small = self.dec.prefill(
                     self.params, small, prompt, chunk=win
                 )
-                first = jnp.argmax(last, axis=-1)[:, None].astype(
-                    prompt.dtype
+                first = self._sampler.admit_first(
+                    i, samp, last, prompt.dtype
                 )
                 self._install_lane(
                     i, slot, rid, steps, prompt, small, first,
-                    t0, adapter_id,
+                    t0, adapter_id, samp, stop_seqs,
                 )
                 continue
             # Bucketed prefill keeps the compiled-shape set small.
@@ -246,17 +346,17 @@ class DecodeServer:
                 logits, small = self.dec.make_step(donate=False)(
                     self.params, small, padded
                 )
-            first = jnp.argmax(logits[:, t0 - 1, :], axis=-1)[
-                :, None
-            ].astype(prompt.dtype)
+            first = self._sampler.admit_first(
+                i, samp, logits[:, t0 - 1, :], prompt.dtype
+            )
             self._install_lane(
                 i, slot, rid, steps, prompt, small, first,
-                P + t0, adapter_id,
+                P + t0, adapter_id, samp, stop_seqs,
             )
 
     def _install_lane(
         self, i, slot, rid, steps, prompt, small, first, pos_val,
-        adapter_id,
+        adapter_id, samp=None, stop_seqs=(),
     ) -> None:
         """The one admission tail both prefill paths share: insert the
         prefilled lane into slot i (rows past pos_val are stale but
@@ -280,10 +380,23 @@ class DecodeServer:
         slot.remaining = steps - 1
         slot.last = first
         slot.toks = [prompt, first]
-        if self.eos_id is not None and int(first[0, 0]) == self.eos_id:
+        slot.sampling = samp is not None
+        if stop_seqs:
+            from defer_tpu.runtime.stopping import StopMatcher
+
+            slot.stop = StopMatcher(stop_seqs)
+        need_host = (
+            self.eos_id is not None
+            or self.on_token is not None
+            or slot.stop is not None
+        )
+        tok_host = int(first[0, 0]) if need_host else None
+        if self.eos_id is not None and tok_host == self.eos_id:
+            slot.remaining = 0
+        if slot.stop is not None and slot.stop.push(tok_host):
             slot.remaining = 0
         if self.on_token is not None:
-            self.on_token(rid, int(first[0, 0]), slot.remaining == 0)
+            self.on_token(rid, tok_host, slot.remaining == 0)
         if slot.remaining == 0:
             self._finish(slot)
 
@@ -307,10 +420,22 @@ class DecodeServer:
         mask = jnp.asarray(active)
         cache = {**cache, "pos": jnp.where(mask, cache["pos"], 0)}
         self.cache = cache
-        nxt = jnp.argmax(logits[:, -1, :], axis=-1)  # (B,)
-        # One device->host transfer per tick for streaming/eos, not
-        # one blocking int() per slot.
-        need_host = self.on_token is not None or self.eos_id is not None
+        if any(
+            s.req is not None and s.sampling for s in self.slots
+        ):
+            nxt = self._sampler.draw(logits[:, -1, :])
+        else:
+            nxt = jnp.argmax(logits[:, -1, :], axis=-1)  # (B,)
+        # One device->host transfer per tick for streaming/eos/stop
+        # matching, not one blocking int() per slot.
+        need_host = (
+            self.on_token is not None
+            or self.eos_id is not None
+            or any(
+                s.req is not None and s.stop is not None
+                for s in self.slots
+            )
+        )
         host_nxt = np.asarray(nxt) if need_host else None
         for i, slot in enumerate(self.slots):
             if slot.req is None:
@@ -322,6 +447,10 @@ class DecodeServer:
             if (
                 self.eos_id is not None
                 and int(host_nxt[i]) == self.eos_id
+            ):
+                slot.remaining = 0
+            if slot.stop is not None and slot.stop.push(
+                int(host_nxt[i])
             ):
                 slot.remaining = 0
             if self.on_token is not None:
@@ -336,6 +465,8 @@ class DecodeServer:
         slot.req = None
         slot.toks = None
         slot.last = None
+        slot.sampling = False
+        slot.stop = None
 
 
 def serve_greedy(
@@ -346,6 +477,7 @@ def serve_greedy(
     max_batch: int = 4,
     prefix_ids: jax.Array | None = None,
     eos_id: int | None = None,
+    sampling: list | None = None,
 ) -> tuple[list[jax.Array], dict]:
     """One-shot convenience: serve `[(prompt, steps), ...]`, returning
     outputs in submission order plus stats (`ticks` batched decode
@@ -358,7 +490,16 @@ def serve_greedy(
         dec, params, max_batch=max_batch, prefix_ids=prefix_ids,
         eos_id=eos_id,
     )
-    rids = [srv.submit(p, s) for p, s in requests]
+    samps = sampling or [None] * len(requests)
+    if len(samps) != len(requests):
+        raise ValueError(
+            f"sampling has {len(samps)} entries for "
+            f"{len(requests)} requests"
+        )
+    rids = [
+        srv.submit(p, s, sampling=sp)
+        for (p, s), sp in zip(requests, samps)
+    ]
     done = srv.run()
     stats = {
         "ticks": srv.ticks,

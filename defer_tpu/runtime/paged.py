@@ -43,7 +43,8 @@ from jax import lax
 
 
 class PagedDecodeServer:
-    """Greedy continuous batching over a paged KV pool.
+    """Continuous batching over a paged KV pool; greedy by default,
+    per-request sampling via `submit(..., sampling=)`.
 
     Protocol-compatible with runtime/decode_server.DecodeServer
     (submit -> run -> {rid: ids}), with the pool replacing per-slot
@@ -109,7 +110,10 @@ class PagedDecodeServer:
         self.pos = np.zeros((max_batch,), np.int32)
         self.adapter = np.zeros((max_batch,), np.int32)
         self.slots: list[dict | None] = [None] * max_batch
-        self.pending: list[tuple[int, jax.Array, int, int]] = []
+        from defer_tpu.runtime.decode_server import SlotSampler
+
+        self._sampler = SlotSampler(max_batch)
+        self.pending: list[tuple] = []
         self.done: dict[int, jax.Array] = {}
         self._next_id = 0
         self.ticks = 0
@@ -186,9 +190,26 @@ class PagedDecodeServer:
         num_steps: int,
         *,
         adapter_id: int = 0,
+        sampling: Any = None,
+        stop: Any = None,
     ) -> int:
+        """`sampling` — optional models/gpt.py SamplingParams: the
+        slot then samples inside the shared batched tick from its own
+        seeded key stream (bit-identical to solo
+        `generate(..., rng=jax.random.key(seed))`); None = greedy.
+        `stop` — optional multi-token stop sequences (iterable of int
+        sequences, runtime/stopping.py): the request finishes the
+        moment its GENERATED tail equals any of them, freeing its
+        blocks mid-budget."""
         if prompt_ids.ndim != 2 or prompt_ids.shape[0] != 1:
             raise ValueError("submit one request at a time ([1, T])")
+        if sampling is not None:
+            sampling.validate()
+            if sampling.temperature == 0:
+                sampling = None  # greedy: keep the argmax fast path
+        from defer_tpu.runtime.stopping import normalize_stops
+
+        stop_seqs = normalize_stops(stop)
         if adapter_id:
             if not self.multi_lora:
                 raise ValueError(
@@ -219,7 +240,10 @@ class PagedDecodeServer:
             )
         rid = self._next_id
         self._next_id += 1
-        self.pending.append((rid, prompt_ids, num_steps, adapter_id))
+        self.pending.append(
+            (rid, prompt_ids, num_steps, adapter_id, sampling,
+             stop_seqs)
+        )
         return rid
 
     def _own_need(self, t0: int, steps: int) -> int:
@@ -348,7 +372,8 @@ class PagedDecodeServer:
         for i in range(self.B):
             if self.slots[i] is not None or not self.pending:
                 continue
-            rid, prompt, steps, adapter_id = self.pending[0]
+            (rid, prompt, steps, adapter_id, samp,
+             stop_seqs) = self.pending[0]
             t0 = prompt.shape[1]
             P = self.prefix_len
             n_shared = len(self.shared_blocks)
@@ -397,9 +422,9 @@ class PagedDecodeServer:
                 small["v"],
                 jnp.asarray(table_row),
             )
-            first = jnp.argmax(logits[:, t0 - 1, :], axis=-1)[
-                :, None
-            ].astype(prompt.dtype)
+            first = self._sampler.admit_first(
+                i, samp, logits[:, t0 - 1, :], prompt.dtype
+            )
             self.tables[i] = table_row
             self.pos[i] = P + t0
             self.adapter[i] = adapter_id
@@ -409,12 +434,21 @@ class PagedDecodeServer:
                 "last": first,
                 "toks": [prompt, first],
                 "blocks": blocks,
+                "sampling": samp is not None,
+                "stop": None,
             }
+            if stop_seqs:
+                from defer_tpu.runtime.stopping import StopMatcher
+
+                slot["stop"] = StopMatcher(stop_seqs)
             self.slots[i] = slot
-            # Host transfer only when eos/streaming consumes the value
-            # (same guard as _tick) — the plain path stays async.
+            # Host transfer only when eos/streaming/stop matching
+            # consumes the value (same guard as _tick) — the plain
+            # path stays async.
             need_host = (
-                self.eos_id is not None or self.on_token is not None
+                self.eos_id is not None
+                or self.on_token is not None
+                or slot["stop"] is not None
             )
             self._emit_token(
                 i, slot, int(first[0, 0]) if need_host else None
@@ -451,10 +485,21 @@ class PagedDecodeServer:
             jnp.asarray(self.adapter.copy()),
         )
         self.ticks += 1
-        nxt = jnp.argmax(logits[:, -1, :], axis=-1)
-        # Host transfer only when eos/streaming needs the values —
-        # the plain path stays async (same guard as the flat server).
-        need_host = self.eos_id is not None or self.on_token is not None
+        if any(s is not None and s["sampling"] for s in self.slots):
+            nxt = self._sampler.draw(logits[:, -1, :])
+        else:
+            nxt = jnp.argmax(logits[:, -1, :], axis=-1)
+        # Host transfer only when eos/streaming/stop matching needs
+        # the values — the plain path stays async (same guard as the
+        # flat server).
+        need_host = (
+            self.eos_id is not None
+            or self.on_token is not None
+            or any(
+                s is not None and s["stop"] is not None
+                for s in self.slots
+            )
+        )
         host_nxt = np.asarray(nxt) if need_host else None
         for i, slot in enumerate(self.slots):
             if slot is None:
@@ -477,6 +522,12 @@ class PagedDecodeServer:
             self.eos_id is not None
             and tok is not None
             and tok == self.eos_id
+        ):
+            slot["remaining"] = 0
+        if (
+            slot["stop"] is not None
+            and tok is not None
+            and slot["stop"].push(tok)
         ):
             slot["remaining"] = 0
         if self.on_token is not None:
@@ -505,10 +556,12 @@ def serve_paged(
     eos_id: int | None = None,
     adapter_ids: list | None = None,
     prefix_ids: jax.Array | None = None,
+    sampling: list | None = None,
 ) -> tuple[list[jax.Array], dict]:
     """One-shot paged serving; returns (outputs in submission order,
     stats incl. peak pool usage). `adapter_ids` optionally assigns a
-    LoRA adapter per request (parallel/lora.py::stack_adapters)."""
+    LoRA adapter per request (parallel/lora.py::stack_adapters);
+    `sampling` optionally assigns a SamplingParams per request."""
     srv = PagedDecodeServer(
         dec,
         params,
@@ -524,9 +577,15 @@ def serve_paged(
             f"adapter_ids has {len(aids)} entries for "
             f"{len(requests)} requests"
         )
+    samps = sampling or [None] * len(requests)
+    if len(samps) != len(requests):
+        raise ValueError(
+            f"sampling has {len(samps)} entries for "
+            f"{len(requests)} requests"
+        )
     rids = [
-        srv.submit(p, s, adapter_id=a)
-        for (p, s), a in zip(requests, aids)
+        srv.submit(p, s, adapter_id=a, sampling=sp)
+        for (p, s), a, sp in zip(requests, aids, samps)
     ]
     done = srv.run()
     stats = {

@@ -1,0 +1,63 @@
+"""Multi-token stop sequences: host-side suffix matching on streamed
+tokens.
+
+A single stop TOKEN (eos_id) jits cleanly — it is a per-row equality
+in the device step (models/gpt.py apply_eos). A stop SEQUENCE cannot:
+the match window spans ticks, and serving must stop the request the
+moment the suffix completes, mid-budget. The natural seam is the same
+host-side point where streamed tokens already surface (the servers'
+`_emit_token` paths and `sampled_decode_loop`'s per-token host sync):
+each stream keeps the last max_stop-1 tokens and an O(num_stops)
+suffix compare per emitted token — exact, allocation-free, and
+decoupled from the jitted tick, which never learns stop sequences
+exist.
+
+Matching covers GENERATED tokens only (the serving-standard contract:
+a stop sequence never triggers on prompt content, and the emitted
+output ENDS WITH the stop sequence, mirroring eos). The reference has
+no text generation at all (it streams CNN frames, reference
+src/test.py:30-41); this generalizes the stop-token machinery of the
+beyond-reference serving surface.
+"""
+
+from __future__ import annotations
+
+
+def normalize_stops(stop_sequences) -> tuple[tuple[int, ...], ...]:
+    """Validate and canonicalize `stop_sequences` (an iterable of
+    non-empty int sequences) to a tuple of int tuples."""
+    if stop_sequences is None:
+        return ()
+    seqs = []
+    for s in stop_sequences:
+        t = tuple(int(x) for x in s)
+        if not t:
+            raise ValueError("empty stop sequence")
+        seqs.append(t)
+    return tuple(seqs)
+
+
+class StopMatcher:
+    """Suffix matcher for ONE token stream: push() each generated
+    token; returns True the moment the stream's tail equals any stop
+    sequence. Keeps only the longest-stop-minus-one history."""
+
+    __slots__ = ("seqs", "keep", "hist")
+
+    def __init__(self, seqs: tuple[tuple[int, ...], ...]):
+        if not seqs:
+            raise ValueError("StopMatcher needs at least one sequence")
+        self.seqs = seqs
+        self.keep = max(len(s) for s in seqs)
+        self.hist: list[int] = []
+
+    def push(self, tok: int) -> bool:
+        self.hist.append(int(tok))
+        if len(self.hist) > self.keep:
+            del self.hist[: len(self.hist) - self.keep]
+        h = self.hist
+        n = len(h)
+        for s in self.seqs:
+            if n >= len(s) and tuple(h[n - len(s):]) == s:
+                return True
+        return False

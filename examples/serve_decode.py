@@ -42,6 +42,11 @@ def main() -> None:
                     help="multi-LoRA: attach this many random adapter "
                     "banks and round-robin requests across them "
                     "(id 0 = base model)")
+    ap.add_argument("--stop-demo", action="store_true",
+                    help="multi-token stop sequences: learn a 2-token "
+                    "stop from request 0's greedy stream, re-serve it "
+                    "with that stop and show it terminates mid-budget "
+                    "with its output ending in the stop sequence")
     ap.add_argument("--check", action="store_true",
                     help="verify the echoed prompt comes back verbatim "
                     "and every generated token is a valid greedy choice "
@@ -153,6 +158,35 @@ def main() -> None:
             else ""
         )
     )
+
+    if args.stop_demo:
+        import numpy as np
+
+        p0, s0 = reqs[0]
+        base = np.asarray(done[rids[0]])[0]
+        gen0 = base[p0.shape[1]:]
+        if len(gen0) < 4:
+            print("stop-demo: request 0 too short to demo, skipping")
+        else:
+            # The pair at generated positions 1-2 is (one of) the
+            # earliest 2-token windows, so serving with it as a stop
+            # sequence must terminate at or before position 2.
+            stop = [int(gen0[1]), int(gen0[2])]
+            srv2 = DecodeServer(
+                dec, params, max_batch=args.slots, prefix_ids=prefix
+            )
+            rid = srv2.submit(
+                p0, s0, adapter_id=adapter_of(0), stop=[stop]
+            )
+            out = np.asarray(srv2.run()[rid])[0]
+            emitted = len(out) - p0.shape[1]
+            assert emitted < s0, (emitted, s0)
+            assert list(out[-2:]) == stop, (out[-2:], stop)
+            print(
+                f"stop-demo: stop={stop} terminated request 0 after "
+                f"{emitted} of {s0} budgeted tokens, output ends "
+                "with the stop sequence"
+            )
 
     if args.check:
         # Token-level equality with a solo decode is ill-conditioned at

@@ -244,3 +244,187 @@ def test_server_serves_int8_params():
     for (prompt, steps), got in zip(reqs, outs):
         want = dec.generate(params, prompt, steps)
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def _sampling_cases():
+    from defer_tpu.models.gpt import SamplingParams
+
+    return [
+        SamplingParams(temperature=0.8, top_k=20, seed=7),
+        None,  # greedy slot sharing ticks with sampled neighbors
+        SamplingParams(temperature=1.3, top_p=0.9, min_p=0.05, seed=42),
+        SamplingParams(temperature=0.6, top_k=8, top_p=0.95, seed=3),
+        SamplingParams(temperature=1.0, seed=0),
+    ]
+
+
+def _solo_reference(dec, params, prompt, steps, sp):
+    if sp is None:
+        return dec.generate(params, prompt, steps)
+    return dec.generate(
+        params, prompt, steps,
+        temperature=sp.temperature, top_k=sp.top_k, top_p=sp.top_p,
+        min_p=sp.min_p, rng=jax.random.key(sp.seed),
+    )
+
+
+@pytest.mark.parametrize("family", ["gpt", "llama"])
+def test_per_request_sampling_matches_solo(family):
+    """Each sampled slot must reproduce solo
+    `generate(..., rng=jax.random.key(seed))` BIT-FOR-BIT while
+    sharing batched ticks with slots running other policies (and a
+    greedy slot): per-slot key streams split exactly once per emitted
+    token, and the batched truncate reproduces each row's static
+    filters."""
+    dec = tiny_gpt(64) if family == "gpt" else tiny_llama(64)
+    params = dec.init(jax.random.key(0))
+    reqs = _requests(dec.cfg.vocab_size)
+    samps = _sampling_cases()
+    outs, _ = serve_greedy(
+        dec, params, reqs, max_batch=2, sampling=samps
+    )
+    for (prompt, steps), sp, got in zip(reqs, samps, outs):
+        want = _solo_reference(dec, params, prompt, steps, sp)
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(want),
+            err_msg=f"{family} sampling={sp}",
+        )
+
+
+def test_sampling_slot_reuse_resets_policy():
+    """A greedy request admitted into the slot a sampled request
+    vacated must not inherit the stale temperature row."""
+    from defer_tpu.models.gpt import SamplingParams
+
+    dec = tiny_gpt(64)
+    params = dec.init(jax.random.key(0))
+    reqs = _requests(dec.cfg.vocab_size)[:2]
+    srv = DecodeServer(dec, params, max_batch=1)
+    r1 = srv.submit(
+        reqs[0][0], reqs[0][1],
+        sampling=SamplingParams(temperature=1.5, seed=1),
+    )
+    r2 = srv.submit(reqs[1][0], reqs[1][1])  # greedy, same slot later
+    done = srv.run()
+    np.testing.assert_array_equal(
+        np.asarray(done[r2]),
+        np.asarray(dec.generate(params, reqs[1][0], reqs[1][1])),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(done[r1]),
+        np.asarray(
+            _solo_reference(
+                dec, params, reqs[0][0], reqs[0][1],
+                SamplingParams(temperature=1.5, seed=1),
+            )
+        ),
+    )
+
+
+def test_sampling_validation():
+    from defer_tpu.models.gpt import SamplingParams
+
+    dec = tiny_gpt(32)
+    srv = DecodeServer(dec, dec.init(jax.random.key(0)), max_batch=1)
+    prompt = jnp.asarray([[1, 2]], jnp.int32)
+    with pytest.raises(ValueError, match="temperature"):
+        srv.submit(
+            prompt, 2, sampling=SamplingParams(temperature=-1.0)
+        )
+    with pytest.raises(ValueError, match="top_p"):
+        srv.submit(
+            prompt, 2,
+            sampling=SamplingParams(temperature=1.0, top_p=0.0),
+        )
+
+
+def test_truncate_logits_batched_matches_static():
+    """Row-by-row bit-equality of the batched filter against the
+    static-parameter truncate_logits across the policy grid (incl.
+    disabled filters reducing to neutral thresholds)."""
+    from defer_tpu.models.gpt import (
+        truncate_logits,
+        truncate_logits_batched,
+    )
+
+    cases = [
+        (0, 1.0, 0.0),
+        (5, 1.0, 0.0),
+        (0, 0.7, 0.0),
+        (0, 1.0, 0.2),
+        (12, 0.85, 0.05),
+        (1, 0.5, 0.5),
+    ]
+    logits = jax.random.normal(
+        jax.random.key(11), (len(cases), 33)
+    ) * 3.0
+    got = truncate_logits_batched(
+        logits,
+        jnp.asarray([c[0] for c in cases], jnp.int32),
+        jnp.asarray([c[1] for c in cases], jnp.float32),
+        jnp.asarray([c[2] for c in cases], jnp.float32),
+    )
+    for r, (k, p, mp) in enumerate(cases):
+        want = truncate_logits(
+            logits[r:r + 1], top_k=k, top_p=p, min_p=mp
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got[r]), np.asarray(want[0]),
+            err_msg=f"row {r}: top_k={k} top_p={p} min_p={mp}",
+        )
+
+
+def test_stop_sequence_finishes_request_mid_budget():
+    """A request whose generated tail completes a 2-token stop
+    sequence must finish right there — its output ends with the stop
+    sequence, short of its step budget — and the vacated slot serves
+    the queue; an identical request without the stop runs out its
+    full budget."""
+    dec = tiny_gpt(64)
+    params = dec.init(jax.random.key(0))
+    prompt = jnp.asarray([[3, 9, 27]], jnp.int32)
+    full = np.asarray(dec.generate(params, prompt, 12))[0]
+    gen = full[3:]
+    stop = [int(gen[5]), int(gen[6])]
+    srv = DecodeServer(dec, params, max_batch=2)
+    r_stop = srv.submit(prompt, 12, stop=[stop])
+    r_free = srv.submit(prompt, 12)
+    done = srv.run()
+    got = np.asarray(done[r_stop])[0]
+    assert len(got) == 3 + 7, got  # mid-budget: 7 of 12 steps
+    assert list(got[-2:]) == stop
+    np.testing.assert_array_equal(got, full[: len(got)])
+    np.testing.assert_array_equal(np.asarray(done[r_free])[0], full)
+
+
+def test_stop_sequence_composes_with_sampling():
+    """Stop matching runs on the sampled stream: serve once sampled to
+    learn its tokens, then re-serve with a 2-token stop drawn from
+    that stream — the output must be the same stream truncated at the
+    stop."""
+    from defer_tpu.models.gpt import SamplingParams
+
+    dec = tiny_gpt(64)
+    params = dec.init(jax.random.key(0))
+    prompt = jnp.asarray([[11, 2, 8]], jnp.int32)
+    sp = SamplingParams(temperature=1.1, top_k=30, seed=9)
+    base = np.asarray(
+        dec.generate(
+            params, prompt, 12, temperature=sp.temperature,
+            top_k=sp.top_k, rng=jax.random.key(sp.seed),
+        )
+    )[0]
+    gen = base[3:]
+    stop = [int(gen[4]), int(gen[5])]
+    # The pair could occur earlier in the stream; find its FIRST
+    # occurrence to predict the cut point.
+    first_end = next(
+        j
+        for j in range(1, len(gen))
+        if [int(gen[j - 1]), int(gen[j])] == stop
+    )
+    srv = DecodeServer(dec, params, max_batch=2)
+    r = srv.submit(prompt, 12, sampling=sp, stop=[stop])
+    got = np.asarray(srv.run()[r])[0]
+    assert len(got) == 3 + first_end + 1, (got, base, stop)
+    np.testing.assert_array_equal(got, base[: len(got)])

@@ -58,8 +58,12 @@ def test_serve_decode_example_checked(prefix, adapters):
         args += ["--prefix", str(prefix)]
     if adapters:
         args += ["--adapters", str(adapters)]
+    else:
+        args += ["--stop-demo"]
     out = _run(args)
     assert "valid greedy choices" in out
+    if not adapters:
+        assert "terminated request 0" in out
     if prefix:
         assert "prefill tokens reused" in out
     else:

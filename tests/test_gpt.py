@@ -258,6 +258,60 @@ def test_generate_stops_at_eos():
         assert (out[b, 4 + cut :] == eos).all() or len(hits) == 0
 
 
+def test_generate_stops_at_stop_sequence():
+    """Multi-token stop sequences (runtime/stopping.py): each row
+    matches the unstopped run up to and including the first completion
+    of a 2-token stop in its GENERATED tail, pins later positions to
+    pad_id, keeps the [B, T0+N] shape, and a batch with per-row match
+    points stops each row independently."""
+    dec = tiny_gpt()
+    params = dec.init(jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(1), (2, 4), 0, 128)
+    free = np.asarray(dec.generate(params, prompt, 10))
+    # Use the 2-token window row 0 emits at generated steps 4-5.
+    stop = [int(free[0, 4 + 4]), int(free[0, 4 + 5])]
+    out = np.asarray(
+        dec.generate(params, prompt, 10, stop_sequences=[stop])
+    )
+    assert out.shape == free.shape
+    for b in range(2):
+        gen_free = free[b, 4:]
+        cut = None  # index of the last token of the first match
+        for j in range(1, len(gen_free)):
+            if [int(gen_free[j - 1]), int(gen_free[j])] == stop:
+                cut = j
+                break
+        if cut is None:
+            np.testing.assert_array_equal(out[b], free[b])
+        else:
+            np.testing.assert_array_equal(
+                out[b, 4 : 4 + cut + 1], gen_free[: cut + 1]
+            )
+            assert (out[b, 4 + cut + 1 :] == 0).all()
+    # Row 0 stops mid-budget by construction.
+    assert (out[0, 4 + 6 :] == 0).all()
+
+
+def test_stop_sequences_ignore_eos_padding():
+    """eos + stop together: an eos-finished row's pinned padding is
+    NOT generated content, so it must never complete a stop sequence
+    — even one made of eos tokens — and the output must equal the
+    eos-only run whenever no stop matches real tokens."""
+    dec = tiny_gpt()
+    params = dec.init(jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(1), (2, 4), 0, 128)
+    free = np.asarray(dec.generate(params, prompt, 10))
+    eos = int(free[0, 4 + 3])
+    out_eos = np.asarray(dec.generate(params, prompt, 10, eos_id=eos))
+    for stop in ([99999, 99998], [eos, eos]):
+        out = np.asarray(
+            dec.generate(
+                params, prompt, 10, eos_id=eos, stop_sequences=[stop]
+            )
+        )
+        np.testing.assert_array_equal(out_eos, out, err_msg=f"{stop}")
+
+
 def test_tp_sharded_decode_matches_single_device(devices):
     """SpmdGptDecoder over model=2: head-sharded caches + Megatron
     projections reproduce the single-device decoder exactly, through

@@ -210,3 +210,59 @@ def test_paged_validation():
             rolling, rolling.init(jax.random.key(1)),
             num_blocks=4, block_size=4,
         )
+
+
+@pytest.mark.parametrize("family", ["gpt", "llama"])
+def test_paged_per_request_sampling_matches_solo(family):
+    """Per-request sampling over the paged pool: every slot's output
+    must be bit-identical to solo generate with the same seed while
+    sharing ticks with other policies and a greedy neighbor."""
+    from defer_tpu.models.gpt import SamplingParams
+
+    dec = tiny_gpt(64) if family == "gpt" else tiny_llama(64)
+    params = dec.init(jax.random.key(0))
+    reqs = _requests(dec.cfg.vocab_size)[:4]
+    samps = [
+        SamplingParams(temperature=0.8, top_k=20, seed=7),
+        None,
+        SamplingParams(temperature=1.3, top_p=0.9, min_p=0.05, seed=42),
+        SamplingParams(temperature=1.0, seed=5),
+    ]
+    outs, _ = serve_paged(
+        dec, params, reqs, num_blocks=40, block_size=8,
+        max_batch=2, sampling=samps,
+    )
+    for (prompt, steps), sp, got in zip(reqs, samps, outs):
+        if sp is None:
+            want = dec.generate(params, prompt, steps)
+        else:
+            want = dec.generate(
+                params, prompt, steps, temperature=sp.temperature,
+                top_k=sp.top_k, top_p=sp.top_p, min_p=sp.min_p,
+                rng=jax.random.key(sp.seed),
+            )
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(want),
+            err_msg=f"{family} sampling={sp}",
+        )
+
+
+def test_paged_stop_sequence_frees_blocks_mid_budget():
+    """The paged server's stop-sequence path: the request terminates
+    the moment its tail matches, its blocks return to the pool, and
+    the output equals the unstopped stream truncated at the match."""
+    dec = tiny_gpt(64)
+    params = dec.init(jax.random.key(0))
+    prompt = jnp.asarray([[3, 9, 27]], jnp.int32)
+    full = np.asarray(dec.generate(params, prompt, 12))[0]
+    stop = [int(full[3 + 5]), int(full[3 + 6])]
+    srv = PagedDecodeServer(
+        dec, params, num_blocks=20, block_size=8, max_batch=2
+    )
+    r = srv.submit(prompt, 12, stop=[stop])
+    done = srv.run()
+    got = np.asarray(done[r])[0]
+    assert len(got) == 3 + 7, got
+    assert list(got[-2:]) == stop
+    np.testing.assert_array_equal(got, full[: len(got)])
+    assert srv.blocks_in_use == 0 and len(srv.free) == 19
