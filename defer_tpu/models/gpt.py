@@ -291,10 +291,7 @@ def sampled_decode_loop(
     finished = jnp.zeros((b,), bool) if eos_id is not None else None
     matchers = None
     if stop_sequences:
-        from defer_tpu.runtime.stopping import (
-            StopMatcher,
-            normalize_stops,
-        )
+        from defer_tpu.runtime.stopping import StopMatcher, normalize_stops
 
         seqs = normalize_stops(stop_sequences)
         matchers = [StopMatcher(seqs) for _ in range(b)]

@@ -53,6 +53,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from defer_tpu.models.gpt import sample_token_batched
+from defer_tpu.runtime.stopping import matcher_or_none, normalize_stops
+
 
 class SlotSampler:
     """Per-slot sampling state shared by both continuous-batching
@@ -82,8 +85,6 @@ class SlotSampler:
         """First generated token of an admission [1, 1]: greedy
         argmax, or the first draw of the request's key stream, with
         the advanced key and policy installed into slot i's rows."""
-        from defer_tpu.models.gpt import sample_token_batched
-
         if samp is None:
             if self.row_temp[i] != 0.0:
                 self.temp = self.temp.at[i].set(0.0)
@@ -111,8 +112,6 @@ class SlotSampler:
         """One batched draw over every slot's policy (B,): sampled
         rows split their own key exactly once, greedy rows reduce to
         the same argmax as the fast path. Advances the key state."""
-        from defer_tpu.models.gpt import sample_token_batched
-
         nxt, self.keys = sample_token_batched(
             logits_last,
             self.keys,
@@ -238,8 +237,6 @@ class DecodeServer:
             sampling.validate()
             if sampling.temperature == 0:
                 sampling = None  # greedy: keep the argmax fast path
-        from defer_tpu.runtime.stopping import normalize_stops
-
         stop_seqs = normalize_stops(stop)
         if adapter_id:
             if not self.multi_lora:
@@ -381,10 +378,7 @@ class DecodeServer:
         slot.last = first
         slot.toks = [prompt, first]
         slot.sampling = samp is not None
-        if stop_seqs:
-            from defer_tpu.runtime.stopping import StopMatcher
-
-            slot.stop = StopMatcher(stop_seqs)
+        slot.stop = matcher_or_none(stop_seqs)
         need_host = (
             self.eos_id is not None
             or self.on_token is not None

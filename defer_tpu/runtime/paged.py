@@ -41,6 +41,103 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from defer_tpu.runtime.decode_server import SlotSampler
+from defer_tpu.runtime.stopping import matcher_or_none, normalize_stops
+
+
+class PrefixBlockCache:
+    """Host-side EXACT radix cache over pool blocks (the vLLM/SGLang
+    automatic-prefix-caching idea, block-granular).
+
+    A K/V block's content is a pure function of the token ANCESTRY it
+    covers — every token from position 0 through its last row — so the
+    cache keys each block by the bytes of that ancestry: lookups walk
+    a request's leading full prompt blocks and stop at the first miss
+    (exactly the radix-tree path walk, flattened into one dict).
+    Blocks referenced by active requests carry a refcount; at
+    refcount 0 a block is RETAINED in LRU order and revived on a
+    later hit, evicted (key dropped, block returned to the caller's
+    free list) only under allocation pressure. Only full blocks whose
+    rows are all prompt content are ever registered — any block a
+    request will write generated tokens into stays private."""
+
+    def __init__(self):
+        self.by_key: dict[bytes, int] = {}
+        self.ref: dict[int, int] = {}
+        self.key_of: dict[int, bytes] = {}
+        self.lru: dict[int, None] = {}  # refcount-0 blocks, dict=LRU
+
+    @staticmethod
+    def block_key(tokens: np.ndarray, j: int, bs: int) -> bytes:
+        """Ancestry key of block j: tokens[0 : (j+1)*bs]."""
+        return tokens[: (j + 1) * bs].astype(np.int64).tobytes()
+
+    def lookup(self, tokens: np.ndarray, n_full: int, bs: int) -> list[int]:
+        """Leading-hit walk: pool blocks for blocks 0..k-1 where k is
+        the first miss among the n_full full prompt blocks. Bumps
+        refcounts (reviving LRU entries)."""
+        hits = []
+        for j in range(n_full):
+            blk = self.by_key.get(self.block_key(tokens, j, bs))
+            if blk is None:
+                break
+            if self.ref[blk] == 0:
+                self.lru.pop(blk, None)
+            self.ref[blk] += 1
+            hits.append(blk)
+        return hits
+
+    def register(
+        self, tokens: np.ndarray, j: int, bs: int, blk: int
+    ) -> int | None:
+        """Publish block j (freshly prefilled by its owner) for future
+        hits, with refcount 1 held by the registrant. Returns a
+        DISPLACED block to free, if this key was still cached from an
+        earlier, partially-evicted chain: the lookup walk stops at the
+        first miss, so a deeper same-key survivor is unreachable and
+        must be forgotten here — silently overwriting the maps would
+        leave its key_of entry aliasing the new block and corrupt a
+        later eviction. A displaced block is always refcount 0: any
+        ACTIVE holder of a deeper block also holds (and refcounts) the
+        whole chain above it, which would have made this key a hit.
+        (Deepest-first parking in _finish makes shallow keys outlive
+        deep ones, so this path should be unreachable — it stays as
+        defense for the invariant, asserted below.)"""
+        key = self.block_key(tokens, j, bs)
+        displaced = self.by_key.get(key)
+        if displaced is not None:
+            assert self.ref[displaced] == 0, (key, displaced)
+            del self.lru[displaced]
+            del self.ref[displaced]
+            del self.key_of[displaced]
+        self.by_key[key] = blk
+        self.ref[blk] = 1
+        self.key_of[blk] = key
+        return displaced
+
+    def release(self, blk: int) -> None:
+        """Drop one reference; at 0 the block parks in LRU (still
+        cached) rather than returning to the free list."""
+        self.ref[blk] -= 1
+        if self.ref[blk] == 0:
+            self.lru[blk] = None
+
+    def evict(self, n: int) -> list[int]:
+        """Forget up to n least-recently-parked blocks; returns them
+        for the free list."""
+        out = []
+        while self.lru and len(out) < n:
+            blk = next(iter(self.lru))
+            del self.lru[blk]
+            del self.by_key[self.key_of.pop(blk)]
+            del self.ref[blk]
+            out.append(blk)
+        return out
+
+    @property
+    def cached_blocks(self) -> int:
+        return len(self.by_key)
+
 
 class PagedDecodeServer:
     """Continuous batching over a paged KV pool; greedy by default,
@@ -49,6 +146,15 @@ class PagedDecodeServer:
     Protocol-compatible with runtime/decode_server.DecodeServer
     (submit -> run -> {rid: ids}), with the pool replacing per-slot
     max_len lanes. `num_blocks` INCLUDES the reserved trash block 0.
+
+    `prefix_cache=True` turns on PER-REQUEST shared-prefix paging
+    (PrefixBlockCache): any subset of requests sharing any leading
+    prompt content automatically shares those full blocks — admission
+    gathers the hit blocks into a flat lane and prefills only the
+    suffix, finished requests park their shared blocks at refcount 0
+    for later revival, and eviction happens only under pool pressure.
+    This generalizes the constructor-level `prefix_ids` (one global
+    system prompt, still supported, mutually exclusive).
     """
 
     def __init__(
@@ -62,6 +168,7 @@ class PagedDecodeServer:
         eos_id: int | None = None,
         on_token: Any = None,
         prefix_ids: jax.Array | None = None,
+        prefix_cache: bool = False,
     ):
         """`on_token(request_id, token_id, done)` — optional streaming
         callback, same contract as the flat server's.
@@ -110,8 +217,6 @@ class PagedDecodeServer:
         self.pos = np.zeros((max_batch,), np.int32)
         self.adapter = np.zeros((max_batch,), np.int32)
         self.slots: list[dict | None] = [None] * max_batch
-        from defer_tpu.runtime.decode_server import SlotSampler
-
         self._sampler = SlotSampler(max_batch)
         self.pending: list[tuple] = []
         self.done: dict[int, jax.Array] = {}
@@ -120,9 +225,26 @@ class PagedDecodeServer:
         self.blocks_peak = 0
         self._step = None
         self._insert = None
+        self._insert_dyn = None
         self.prefix_len = 0
         self.shared_blocks: list[int] = []
         self._prefix_cache = None
+        self.radix: PrefixBlockCache | None = None
+        self._gather = None
+        self.prefill_tokens_saved = 0
+        if prefix_cache:
+            if prefix_ids is not None:
+                raise ValueError(
+                    "prefix_cache=True subsumes the global prefix_ids "
+                    "— pass the system prompt as part of each "
+                    "request's prompt and it shares automatically"
+                )
+            if self.multi_lora:
+                raise ValueError(
+                    "prefix_cache + multi-LoRA is unsupported: cached "
+                    "prefix K/V would be adapter-dependent"
+                )
+            self.radix = PrefixBlockCache()
         if prefix_ids is not None:
             if self.multi_lora:
                 raise ValueError(
@@ -207,8 +329,6 @@ class PagedDecodeServer:
             sampling.validate()
             if sampling.temperature == 0:
                 sampling = None  # greedy: keep the argmax fast path
-        from defer_tpu.runtime.stopping import normalize_stops
-
         stop_seqs = normalize_stops(stop)
         if adapter_id:
             if not self.multi_lora:
@@ -260,6 +380,16 @@ class PagedDecodeServer:
 
     @property
     def blocks_in_use(self) -> int:
+        if self.radix is not None:
+            # Exact pool accounting: everything that is neither free
+            # nor parked at refcount 0 is held by an active request
+            # (shared blocks counted once, however many slots point at
+            # them).
+            return (
+                (int(self.pool_k.shape[1]) - 1)
+                - len(self.free)
+                - len(self.radix.lru)
+            )
         return sum(len(s["blocks"]) for s in self.slots if s)
 
     # -- internals --------------------------------------------------------
@@ -282,6 +412,17 @@ class PagedDecodeServer:
             ("paged_insert", self.bs, skip),
             lambda: self._build_insert(skip),
         )
+        if self.radix is not None and self._gather is None:
+            self._gather = cached_step(
+                self.dec,
+                ("paged_gather", self.bs),
+                self._build_gather,
+            )
+            self._insert_dyn = cached_step(
+                self.dec,
+                ("paged_insert_dyn", self.bs),
+                self._build_insert_dynamic,
+            )
 
     def _build_step(self):
         dec, bs = self.dec, self.bs
@@ -368,12 +509,186 @@ class PagedDecodeServer:
 
         return jax.jit(insert, donate_argnums=(0, 1))
 
+    def _build_insert_dynamic(self):
+        """The radix variant of _build_insert: `skip` is a RUNTIME
+        scalar (per-admission hit count), so one compiled program
+        serves every skip value. Leading hit blocks are not this
+        request's to touch — and their recomputed rows are only
+        equivalent, not guaranteed bit-identical, so rewriting them
+        would perturb concurrent readers — hence their writes are
+        redirected to trash block 0 (duplicate trash writes race over
+        garbage, by the module invariant)."""
+        bs = self.bs
+
+        def insert(pk, pv, small_k, small_v, table_row, skip):
+            mb = table_row.shape[0]
+            s_need = mb * bs
+            k_rows = small_k[:, 0]
+            v_rows = small_v[:, 0]
+            pad = s_need - k_rows.shape[2]
+            if pad > 0:
+                k_rows = jnp.pad(
+                    k_rows, ((0, 0), (0, 0), (0, pad), (0, 0))
+                )
+                v_rows = jnp.pad(
+                    v_rows, ((0, 0), (0, 0), (0, pad), (0, 0))
+                )
+            else:
+                k_rows = k_rows[:, :, :s_need]
+                v_rows = v_rows[:, :, :s_need]
+            L, hkv, _, dh = k_rows.shape
+            k_blocks = k_rows.reshape(L, hkv, mb, bs, dh).transpose(
+                0, 2, 1, 3, 4
+            )
+            v_blocks = v_rows.reshape(L, hkv, mb, bs, dh).transpose(
+                0, 2, 1, 3, 4
+            )
+            dest = jnp.where(jnp.arange(mb) >= skip, table_row, 0)
+            pk = pk.at[:, dest].set(k_blocks)
+            pv = pv.at[:, dest].set(v_blocks)
+            return pk, pv
+
+        return jax.jit(insert, donate_argnums=(0, 1))
+
+    def _build_gather(self):
+        """Jitted (pool_k, pool_v, table_row [MB]) -> flat single-lane
+        K/V ([L, 1, Hkv, MB*bs, Dh]) — the exact inverse layout of
+        _build_insert, used by radix admissions to hand cached prefix
+        blocks to the flat suffix-prefill step. Reads the pool in
+        place (no donation: the pool stays live)."""
+        def gather(pk, pv, table_row):
+            kc = pk[:, table_row]  # [L, MB, Hkv, bs, Dh]
+            vc = pv[:, table_row]
+            L, mb, hkv, bs, dh = kc.shape
+            kc = kc.transpose(0, 2, 1, 3, 4).reshape(
+                L, 1, hkv, mb * bs, dh
+            )
+            vc = vc.transpose(0, 2, 1, 3, 4).reshape(
+                L, 1, hkv, mb * bs, dh
+            )
+            return kc, vc
+
+        return jax.jit(gather)
+
+    def _admit_radix(
+        self, i, rid, prompt, steps, adapter_id, samp, stop_seqs
+    ) -> bool:
+        """Admission through the PrefixBlockCache: walk leading full
+        prompt blocks for hits (refcount++), allocate the rest
+        (evicting parked refcount-0 blocks only under pressure),
+        gather the hit blocks into a flat lane, prefill ONLY the
+        suffix, then publish this request's fresh full prompt blocks
+        for future hits. Returns False (request waits, refcounts
+        rolled back) when even eviction cannot cover the need."""
+        bs = self.bs
+        t0 = prompt.shape[1]
+        tokens = np.asarray(prompt)[0]
+        n_full = t0 // bs
+        total = -(-(t0 + steps) // bs)
+        hits = self.radix.lookup(tokens, n_full, bs)
+        need = total - len(hits)
+        if need > len(self.free):
+            self.free.extend(
+                self.radix.evict(need - len(self.free))
+            )
+        if need > len(self.free):
+            for blk in hits:
+                self.radix.release(blk)
+            return False
+        own = [self.free.pop() for _ in range(need)]
+        self._build()
+        table_row = np.zeros((self.MB,), np.int32)
+        for j, blk in enumerate(hits + own):
+            table_row[j] = blk
+        # Reuse at most t0-1 cached positions: the LAST prompt token
+        # must go through the step so its logits exist to sample the
+        # first generated token (its K/V row is rewritten with
+        # identical content).
+        suffix_pos = min(len(hits) * bs, t0 - 1)
+        if hits:
+            gk, gv = self._gather(
+                self.pool_k, self.pool_v, jnp.asarray(table_row)
+            )
+            small = {
+                "k": gk,
+                "v": gv,
+                "pos": jnp.asarray(suffix_pos, jnp.int32),
+            }
+        else:
+            small = self.dec.init_cache(1)
+        suffix = prompt[:, suffix_pos:]
+        ts = suffix.shape[1]
+        pad = 1 << (ts - 1).bit_length()
+        pad = min(pad, self.dec.cfg.max_len - suffix_pos)
+        padded = jnp.concatenate(
+            [suffix, jnp.zeros((1, pad - ts), prompt.dtype)], axis=1
+        )
+        logits, small = self.dec.make_step()(
+            self.params, small, padded
+        )
+        # Dynamic-skip insert: hit blocks are never rewritten (their
+        # recomputed rows are equivalent but not guaranteed
+        # bit-identical, and they belong to every other holder of the
+        # chain); fresh rows land in this request's blocks; unowned
+        # tail entries point at trash by the module invariant.
+        self.pool_k, self.pool_v = self._insert_dyn(
+            self.pool_k,
+            self.pool_v,
+            small["k"],
+            small["v"],
+            jnp.asarray(table_row),
+            jnp.asarray(len(hits), jnp.int32),
+        )
+        for j in range(len(hits), n_full):
+            displaced = self.radix.register(
+                tokens, j, bs, int(table_row[j])
+            )
+            if displaced is not None:
+                self.free.append(displaced)
+        shared = hits + [int(table_row[j]) for j in range(len(hits), n_full)]
+        owned = [int(table_row[j]) for j in range(n_full, total)]
+        self.prefill_tokens_saved += suffix_pos
+        self.blocks_peak = max(self.blocks_peak, self.blocks_in_use)
+        first = self._sampler.admit_first(
+            i, samp, logits[:, ts - 1, :], prompt.dtype
+        )
+        self.tables[i] = table_row
+        self.pos[i] = t0
+        self.adapter[i] = adapter_id
+        slot = {
+            "rid": rid,
+            "remaining": steps - 1,
+            "last": first,
+            "toks": [prompt, first],
+            "blocks": owned,
+            "shared": shared,
+            "sampling": samp is not None,
+            "stop": matcher_or_none(stop_seqs),
+        }
+        self.slots[i] = slot
+        need_host = (
+            self.eos_id is not None
+            or self.on_token is not None
+            or slot["stop"] is not None
+        )
+        self._emit_token(
+            i, slot, int(first[0, 0]) if need_host else None
+        )
+        return True
+
     def _admit(self) -> None:
         for i in range(self.B):
             if self.slots[i] is not None or not self.pending:
                 continue
             (rid, prompt, steps, adapter_id, samp,
              stop_seqs) = self.pending[0]
+            if self.radix is not None:
+                if not self._admit_radix(
+                    i, rid, prompt, steps, adapter_id, samp, stop_seqs
+                ):
+                    return  # pool exhausted even after eviction
+                self.pending.pop(0)
+                continue
             t0 = prompt.shape[1]
             P = self.prefix_len
             n_shared = len(self.shared_blocks)
@@ -435,12 +750,8 @@ class PagedDecodeServer:
                 "toks": [prompt, first],
                 "blocks": blocks,
                 "sampling": samp is not None,
-                "stop": None,
+                "stop": matcher_or_none(stop_seqs),
             }
-            if stop_seqs:
-                from defer_tpu.runtime.stopping import StopMatcher
-
-                slot["stop"] = StopMatcher(stop_seqs)
             self.slots[i] = slot
             # Host transfer only when eos/streaming/stop matching
             # consumes the value (same guard as _tick) — the plain
@@ -538,6 +849,14 @@ class PagedDecodeServer:
     def _finish(self, i: int) -> None:
         slot = self.slots[i]
         self.done[slot["rid"]] = jnp.concatenate(slot["toks"], axis=1)
+        if self.radix is not None:
+            # Shared blocks deref (parking at refcount 0 for later
+            # revival); only privately owned blocks free immediately.
+            # Released DEEPEST-FIRST so LRU eviction reclaims the
+            # deep end of a chain before its shallow (more reusable,
+            # and prerequisite-for-lookup) blocks.
+            for blk in reversed(slot.get("shared", ())):
+                self.radix.release(blk)
         self.free.extend(slot["blocks"])
         self.tables[i] = 0
         self.pos[i] = 0
@@ -556,6 +875,7 @@ def serve_paged(
     eos_id: int | None = None,
     adapter_ids: list | None = None,
     prefix_ids: jax.Array | None = None,
+    prefix_cache: bool = False,
     sampling: list | None = None,
 ) -> tuple[list[jax.Array], dict]:
     """One-shot paged serving; returns (outputs in submission order,
@@ -570,6 +890,7 @@ def serve_paged(
         max_batch=max_batch,
         eos_id=eos_id,
         prefix_ids=prefix_ids,
+        prefix_cache=prefix_cache,
     )
     aids = adapter_ids or [0] * len(requests)
     if len(aids) != len(requests):
@@ -595,5 +916,9 @@ def serve_paged(
         "block_size": block_size,
         "flat_equivalent_rows": max_batch * dec.cfg.max_len,
         "shared_prefix_blocks": len(srv.shared_blocks),
+        "prefill_tokens_saved": srv.prefill_tokens_saved,
+        "cached_blocks": (
+            srv.radix.cached_blocks if srv.radix is not None else 0
+        ),
     }
     return [done[r] for r in rids], stats

@@ -37,6 +37,12 @@ def normalize_stops(stop_sequences) -> tuple[tuple[int, ...], ...]:
     return tuple(seqs)
 
 
+def matcher_or_none(seqs: tuple[tuple[int, ...], ...]):
+    """One StopMatcher per request when stop sequences were given,
+    else None — the construction every server admission shares."""
+    return StopMatcher(seqs) if seqs else None
+
+
 class StopMatcher:
     """Suffix matcher for ONE token stream: push() each generated
     token; returns True the moment the stream's tail equals any stop
