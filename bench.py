@@ -451,6 +451,7 @@ def bench_paged_server(devices) -> dict:
     import jax
     import jax.numpy as jnp
 
+    from defer_tpu import obs
     from defer_tpu.models.gpt import GptDecoder
     from defer_tpu.models.llama import llama_config
     from defer_tpu.runtime.paged import serve_paged
@@ -481,6 +482,9 @@ def bench_paged_server(devices) -> dict:
         reqs.append((prompt, steps))
 
     def run():
+        # Zero the process registry so the latency distributions below
+        # cover only this pass (the compile pass would skew TTFT).
+        obs.reset()
         t0 = time.perf_counter()
         outs, stats = serve_paged(
             dec, params, reqs, num_blocks=49, block_size=16, max_batch=4
@@ -492,6 +496,10 @@ def bench_paged_server(devices) -> dict:
     dt, stats = run()
     total = sum(s for _, s in reqs)
     pool_rows = stats["pool_blocks"] * stats["block_size"]
+    reg = obs.get_registry()
+    lab = {"server": "paged"}
+    ttft = reg.histogram("defer_ttft_seconds", labels=lab)
+    itl = reg.histogram("defer_itl_seconds", labels=lab)
     rec = {
         "requests": len(reqs),
         "slots": 4,
@@ -502,6 +510,13 @@ def bench_paged_server(devices) -> dict:
             pool_rows / stats["flat_equivalent_rows"], 3
         ),
         "peak_blocks": stats["peak_blocks"],
+        # Host-side dispatch latency (see ARCHITECTURE.md
+        # "Observability" for the async-dispatch caveat).
+        "ttft_p50_ms": round(1e3 * ttft.approx_quantile(0.5), 2),
+        "itl_p50_ms": round(1e3 * itl.approx_quantile(0.5), 3),
+        "tokens_counted": reg.value(
+            "defer_tokens_generated_total", **lab
+        ),
     }
     log(f"paged server (llama-1b, block pool): {rec}")
     return rec

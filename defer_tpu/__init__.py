@@ -25,6 +25,7 @@ from defer_tpu.graph.partition import (
     validate_cut_points,
 )
 from defer_tpu.graph.serialize import graph_from_json, graph_to_json
+from defer_tpu import obs
 from defer_tpu.parallel import (
     Pipeline,
     ReplicatedPipeline,
@@ -47,6 +48,7 @@ __all__ = [
     "graph_from_json",
     "graph_to_json",
     "make_mesh",
+    "obs",
     "partition",
     "run_local_inference",
     "stage_params",

@@ -31,6 +31,7 @@ from defer_tpu.config import DeferConfig, normalize_cuts
 from defer_tpu.graph.ir import Graph, GraphParams
 from defer_tpu.graph.partition import partition
 from defer_tpu.models import Model
+from defer_tpu.obs.metrics import get_registry
 from defer_tpu.parallel.mesh import pipeline_devices
 from defer_tpu.parallel.pipeline import Pipeline
 from defer_tpu.runtime.batching import split_output
@@ -232,6 +233,10 @@ class DEFER:
         """Rebuild the pipeline on the devices that still pass a health
         probe — the recovery the reference lacks entirely (node death
         hangs it forever, reference src/node.py:102-103)."""
+        get_registry().counter(
+            "defer_redispatch_total",
+            "Elastic-recovery pipeline rebuilds after a device failure",
+        ).inc()
         healthy = self._healthy_devices()
         if not healthy:
             raise RuntimeError(
@@ -319,14 +324,21 @@ class DEFER:
                 self.config.dynamic_batch_size, self.config.batch_wait_s
             )
 
+        obs_items = get_registry().counter(
+            "defer_stream_items_total",
+            "Results delivered to the output stream by run_defer",
+        )
+
         def emit(items: Sequence[Any]) -> None:
             for out in items:
                 monitor.completed()
                 if gatherer is None:
                     output_stream.put(out)
+                    obs_items.inc()
                 else:
                     for part in split_output(out, splits.popleft()):
                         output_stream.put(part)
+                        obs_items.inc()
 
         # Unlike Pipeline.stream (pull-based), this loop must keep
         # emitting results while the input queue idles — the reference's
@@ -400,6 +412,10 @@ class DEFER:
                             lost,
                         )
                         monitor.dropped(lost)
+                        get_registry().counter(
+                            "defer_inflight_dropped_total",
+                            "In-flight results lost to pipeline failures",
+                        ).inc(lost)
                     pipe = self._redispatch(e)
             monitor.check()
             since_probe += 1

@@ -215,6 +215,44 @@ def sample_token_batched(
     return toks, carry
 
 
+@jax.jit
+def sample_token_batched_nosort(
+    logits_last: jax.Array,
+    keys: jax.Array,
+    temperature: jax.Array,
+    min_p: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """sample_token_batched for ticks where NO row enables top-k or
+    top-p: both of truncate_logits_batched's full-vocab O(V log V)
+    sorts exist only to find the kth/nucleus thresholds, and with the
+    filters disabled those thresholds are -inf, making their masking
+    `where`s bitwise identity. This variant drops the sorts and keeps
+    every op the survivors see — temperature scale, the min_p
+    floor (same softmax over the same scaled logits), the categorical
+    on the same advanced key — so each row's token is BIT-IDENTICAL
+    to sample_token_batched with top_k=0 / top_p=1 on that row, and
+    the key state advances identically (servers can switch variants
+    tick-by-tick). Dispatch is the caller's job: SlotSampler tracks
+    per-slot policies on the host and routes here only when no active
+    slot sorts."""
+    pair = jax.vmap(lambda kk: jax.random.split(kk, 2))(keys)
+    carry, sub = pair[:, 0], pair[:, 1]
+    greedy = temperature <= 0
+    safe_t = jnp.where(greedy, 1.0, temperature)
+    logits = logits_last / safe_t[:, None]
+    # min_p exactly as in truncate_logits_batched (the top_k where it
+    # follows there is identity at kth = -inf).
+    neg = jnp.finfo(logits.dtype).min
+    probs = jax.nn.softmax(logits, axis=-1)
+    floor = min_p[:, None] * jnp.max(probs, axis=-1, keepdims=True)
+    filtered = jnp.where(probs < floor, neg, logits)
+    sampled = jax.vmap(jax.random.categorical)(sub, filtered)
+    toks = jnp.where(
+        greedy, jnp.argmax(logits_last, axis=-1), sampled
+    )
+    return toks, carry
+
+
 def _flash_decode_mode() -> str | None:
     """Which attention path the T=1 decode step takes: None (the XLA
     einsum — default off-TPU and on tunneled backends), "tpu" (the

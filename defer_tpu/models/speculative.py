@@ -22,6 +22,20 @@ TPU-shaped mechanics on the existing KV-cache decoder:
 The reference has no serving stack at all (it streams CNN frames,
 reference src/test.py:30-41); this joins the beyond-reference serving
 surface alongside dynamic batching and int8 weights.
+
+Reproducibility note (sampled mode, temperature > 0): the PRNG key
+schedule consumes one `jax.random.split` per draft proposal and per
+verification round — plus ONE EXTRA split on every FULL-ACCEPT round,
+where the bonus token is sampled from the verify forward's final
+logits (`rng, sub_b = jax.random.split(rng)` below). That extra split
+means sampled speculative output is NOT stream-identical to
+`target.generate(..., rng=key)` with the same seed, and depends on
+the draft model and k (they shape which rounds fully accept): two
+runs agree only if seed, draft, k, and temperature/filter knobs all
+agree. The DISTRIBUTION is unchanged (each draw still uses a fresh
+subkey); only the key stream differs. Greedy mode (temperature 0)
+consumes no keys and stays bit-identical to the target's greedy
+decode.
 """
 
 from __future__ import annotations

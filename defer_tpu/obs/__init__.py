@@ -1,0 +1,33 @@
+"""defer_tpu.obs — metrics & telemetry for the serving/pipeline runtimes.
+
+Split from `utils/profiling.py` on purpose: profiling captures device
+*traces* (one-shot, heavyweight, opt-in), obs counts and times
+*always-on* host-side events (near-free per sample, pull-based export).
+See ARCHITECTURE.md "Observability".
+"""
+
+from defer_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    log_buckets,
+    reset,
+)
+from defer_tpu.obs.export import PeriodicDumper, prometheus_text
+from defer_tpu.obs.serving import ServerStats, ServingMetrics
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "PeriodicDumper",
+    "ServerStats",
+    "ServingMetrics",
+    "get_registry",
+    "log_buckets",
+    "prometheus_text",
+    "reset",
+]

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from defer_tpu.config import DeferConfig
 from defer_tpu.graph.ir import Graph, GraphParams
 from defer_tpu.graph.partition import StageGraph, stage_params
+from defer_tpu.obs.metrics import get_registry
 from defer_tpu.utils.logging import get_logger
 from defer_tpu.utils.profiling import annotate
 from defer_tpu.utils.sync import Retirer, hard_sync
@@ -141,6 +142,13 @@ class Pipeline(StreamMeasure):
             donate = (1,) if self.config.donate_activations and i > 0 else ()
             self.stage_fns.append(jax.jit(stage_apply, donate_argnums=donate))
             self._plain_fns.append(jax.jit(stage_apply))
+        # One shared counter across every Pipeline (incl. the ones a
+        # ReplicatedPipeline builds per replica): total microbatches
+        # dispatched process-wide.
+        self._obs_microbatches = get_registry().counter(
+            "defer_pipeline_microbatches_total",
+            "Microbatches dispatched through a stage chain",
+        )
 
     @property
     def num_stages(self) -> int:
@@ -164,6 +172,7 @@ class Pipeline(StreamMeasure):
     def __call__(self, x: jax.Array) -> jax.Array:
         """Push one microbatch through the chain (async — the returned
         array is a future; block_until_ready() to wait)."""
+        self._obs_microbatches.inc()
         h = self._place(x, self.devices[0])
         for i, (fn, p) in enumerate(zip(self.stage_fns, self.stage_params)):
             with annotate(f"defer:stage{i}"):
@@ -245,5 +254,18 @@ class Pipeline(StreamMeasure):
                     "amortized_s": amortized,
                 }
             )
+            # Cold path: registry lookup per probe is fine here.
+            reg = get_registry()
+            labels = {"stage": str(i)}
+            reg.gauge(
+                "defer_stage_amortized_seconds",
+                "Amortized per-microbatch stage time (last probe)",
+                labels,
+            ).set(amortized)
+            reg.gauge(
+                "defer_stage_p50_seconds",
+                "Synchronous p50 stage latency (last probe)",
+                labels,
+            ).set(times[len(times) // 2])
             h = fn(p, h)
         return results

@@ -22,7 +22,12 @@ from typing import Any
 
 import jax.numpy as jnp
 
+from defer_tpu.obs.metrics import get_registry
 from defer_tpu.runtime.host_io import STOP
+
+# Leading-dim buckets 1..1024: one histogram bucket per pow2 compile
+# bucket, so occupancy reads directly against the compile-cache story.
+_ROW_BUCKETS = tuple(float(1 << i) for i in range(11))
 
 
 class BatchGatherer:
@@ -50,6 +55,27 @@ class BatchGatherer:
         # rows by construction (sizes sum to the real total).
         self.pad_to_buckets = pad_to_buckets
         self._carry: Any = None
+        # Metric handles resolved once (obs/metrics.py); gather() then
+        # pays one histogram observe + counter inc per FLUSH, nothing
+        # per item.
+        reg = get_registry()
+        self._obs_rows = reg.histogram(
+            "defer_batch_rows",
+            "Device-batch occupancy (rows) per dispatch",
+            _ROW_BUCKETS,
+        )
+        self._obs_wait = reg.histogram(
+            "defer_batch_wait_seconds",
+            "First item to flush (bounded by the batch_wait_s SLO)",
+        )
+        self._obs_flush = {
+            reason: reg.counter(
+                "defer_batch_flush_total",
+                "Batches flushed, by why gathering stopped",
+                {"reason": reason},
+            )
+            for reason in ("full", "timeout", "eos", "mismatch")
+        }
 
     @staticmethod
     def _compatible(a: Any, b: Any) -> bool:
@@ -98,17 +124,22 @@ class BatchGatherer:
         # the device batch never exceeds batch_size (unless a single
         # item is itself larger — items are atomic).
         total = int(items[0].shape[0])
-        deadline = time.monotonic() + self.max_wait_s
+        t_first = time.monotonic()
+        deadline = t_first + self.max_wait_s
+        reason = "full"  # loop exits via its condition when filled
         while total < self.batch_size:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
+                reason = "timeout"
                 break
             try:
                 nxt = input_stream.get(timeout=remaining)
             except queue_mod.Empty:
+                reason = "timeout"
                 break
             if nxt is None or nxt is STOP:
                 eos = True
+                reason = "eos"
                 break
             if (
                 not self._compatible(items[0], nxt)
@@ -116,9 +147,13 @@ class BatchGatherer:
             ):
                 # Flush what we have; the odd item opens the next batch.
                 self._carry = nxt
+                reason = "mismatch"
                 break
             items.append(nxt)
             total += int(nxt.shape[0])
+        self._obs_rows.observe(float(total))
+        self._obs_wait.observe(time.monotonic() - t_first)
+        self._obs_flush[reason].inc()
         sizes = [int(x.shape[0]) for x in items]
         pad = 0
         if self.pad_to_buckets and total < self.batch_size:

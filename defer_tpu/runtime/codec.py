@@ -31,9 +31,19 @@ import zlib
 
 import numpy as np
 
+from defer_tpu.obs.metrics import get_registry
 from defer_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
+
+# Raw-in vs frame-out byte totals; their ratio is the codec's achieved
+# compression over everything this process encoded.
+_obs_raw = get_registry().counter(
+    "defer_codec_raw_bytes_total", "Uncompressed bytes handed to encode()"
+)
+_obs_encoded = get_registry().counter(
+    "defer_codec_encoded_bytes_total", "Frame bytes produced by encode()"
+)
 
 _MAGIC = b"DC"
 _VERSION = 1
@@ -111,7 +121,11 @@ def _unshuffle_np(raw: bytes, elem: int) -> bytes:
 
 
 def encode(
-    arr: np.ndarray, *, level: int = 3, quantize: str | None = None
+    arr: np.ndarray,
+    *,
+    level: int = 3,
+    quantize: str | None = None,
+    _count: bool = True,
 ) -> bytes:
     """Array -> self-describing compressed frame. level=0 skips
     compression entirely (raw passthrough for links where the codec
@@ -144,13 +158,20 @@ def encode(
             )
         scale = amax / 127.0 if amax > 0 else 1.0
         q = np.clip(np.rint(a64 / scale), -127, 127).astype(np.int8)
-        inner = encode(q, level=level)
+        # _count=False: the inner int8 frame is an implementation
+        # detail of THIS encode — letting it count would double-book
+        # the raw bytes and understate the compression ratio.
+        inner = encode(q, level=level, _count=False)
         dtype = arr.dtype.str.encode()
         header = struct.pack(
             f"<2sBBB{len(dtype)}sB", _MAGIC, _VERSION, SCHEME_Q8,
             len(dtype), dtype, 0,
         )
-        return header + struct.pack("<d", scale) + inner
+        frame = header + struct.pack("<d", scale) + inner
+        if _count:
+            _obs_raw.inc(arr.nbytes)
+            _obs_encoded.inc(len(frame))
+        return frame
 
     arr = np.ascontiguousarray(arr)
     raw = arr.tobytes()
@@ -182,7 +203,11 @@ def encode(
         f"<2sBBB{len(dtype)}sB{arr.ndim}q",
         _MAGIC, _VERSION, scheme, len(dtype), dtype, arr.ndim, *arr.shape,
     )
-    return header + payload
+    frame = header + payload
+    if _count:
+        _obs_raw.inc(arr.nbytes)
+        _obs_encoded.inc(len(frame))
+    return frame
 
 
 def decode(frame: bytes) -> np.ndarray:

@@ -47,13 +47,18 @@ bit-identical to solo generation over the concatenated ids.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from defer_tpu.models.gpt import sample_token_batched
+from defer_tpu.models.gpt import (
+    sample_token_batched,
+    sample_token_batched_nosort,
+)
+from defer_tpu.obs.serving import ServerStats, ServingMetrics
 from defer_tpu.runtime.stopping import matcher_or_none, normalize_stops
 
 
@@ -80,12 +85,20 @@ class SlotSampler:
         # temperature would re-route the greedy slot through the
         # categorical path).
         self.row_temp = [0.0] * max_batch
+        # Host mirror of "this row's policy needs the sorting filters"
+        # (top_k or top_p enabled). While no admitted row does, draw()
+        # routes through the sort-free tick variant — same bits, no
+        # O(V log V) sorts. Rows are only ever set at admission, so a
+        # finished sorting slot keeps its True until the slot is
+        # reused: conservatively correct (slow path, same output).
+        self.row_sort = [False] * max_batch
 
     def admit_first(self, i, samp, logits_row, dtype):
         """First generated token of an admission [1, 1]: greedy
         argmax, or the first draw of the request's key stream, with
         the advanced key and policy installed into slot i's rows."""
         if samp is None:
+            self.row_sort[i] = False
             if self.row_temp[i] != 0.0:
                 self.temp = self.temp.at[i].set(0.0)
                 self.row_temp[i] = 0.0
@@ -106,12 +119,21 @@ class SlotSampler:
         self.topp = self.topp.at[i].set(samp.top_p)
         self.minp = self.minp.at[i].set(samp.min_p)
         self.row_temp[i] = samp.temperature
+        self.row_sort[i] = samp.top_k > 0 or samp.top_p < 1.0
         return tok[:, None].astype(dtype)
 
     def draw(self, logits_last):
         """One batched draw over every slot's policy (B,): sampled
         rows split their own key exactly once, greedy rows reduce to
-        the same argmax as the fast path. Advances the key state."""
+        the same argmax as the fast path. Advances the key state.
+        While no admitted row enables top-k/top-p, the draw takes the
+        sort-free variant (bit-identical, see
+        sample_token_batched_nosort)."""
+        if not any(self.row_sort):
+            nxt, self.keys = sample_token_batched_nosort(
+                logits_last, self.keys, self.temp, self.minp
+            )
+            return nxt
         nxt, self.keys = sample_token_batched(
             logits_last,
             self.keys,
@@ -207,6 +229,11 @@ class DecodeServer:
         self.on_token = on_token
         self.eos_id = eos_id
         self.solo_steps = 0  # what per-request loops would have cost
+        # Metric handles resolved once; the tick/admission paths touch
+        # pre-bound attributes only (obs/serving.py).
+        self.obs = ServingMetrics("flat")
+        self._submit_t: dict[int, float] = {}
+        self._last_tick_t: float | None = None
 
     # -- public API -------------------------------------------------------
 
@@ -273,6 +300,7 @@ class DecodeServer:
              stop_seqs)
         )
         self.solo_steps += num_steps
+        self._submit_t[rid] = time.perf_counter()
         return rid
 
     def run(self) -> dict[int, jax.Array]:
@@ -292,6 +320,12 @@ class DecodeServer:
             (rid, prompt, steps, adapter_id, samp,
              stop_seqs) = self.pending.pop(0)
             t0 = prompt.shape[1]
+            self.obs.requests_admitted.inc()
+            self.obs.prefill_tokens.inc(t0)
+            self.obs.queue_wait.observe(
+                time.perf_counter()
+                - self._submit_t.get(rid, time.perf_counter())
+            )
             P = self.prefix_len
             rolling = getattr(self.dec, "rolling_cache", False)
             win = self.dec.cfg.window if rolling else None
@@ -373,6 +407,14 @@ class DecodeServer:
                 self.cache["adapter"].at[i].set(adapter_id)
             )
         self.cache = new_cache
+        # TTFT is host-side: submit() to first-token DISPATCH (the
+        # token array may still be in flight on device — honesty note
+        # in ARCHITECTURE.md "Observability").
+        self.obs.ttft.observe(
+            time.perf_counter()
+            - self._submit_t.pop(rid, time.perf_counter())
+        )
+        self.obs.tokens_generated.inc()
         slot.req = rid
         slot.remaining = steps - 1
         slot.last = first
@@ -409,6 +451,13 @@ class DecodeServer:
         )
         logits, cache = self.step(self.params, self.cache, feed)
         self.ticks += 1
+        n_active = sum(active)
+        now = time.perf_counter()
+        if self._last_tick_t is not None:
+            self.obs.itl.observe(now - self._last_tick_t, n_active)
+        self._last_tick_t = now
+        self.obs.ticks.inc()
+        self.obs.tokens_generated.inc(n_active)
         # Inactive slots wrote a dummy row at their position; pin them
         # back to 0 so they never creep toward max_len.
         mask = jnp.asarray(active)
@@ -455,6 +504,7 @@ class DecodeServer:
                 self._finish(slot)
 
     def _finish(self, slot: _Slot) -> None:
+        self.obs.requests_finished.inc()
         self.done[slot.req] = jnp.concatenate(slot.toks, axis=1)
         slot.req = None
         slot.toks = None
@@ -477,7 +527,9 @@ def serve_greedy(
     outputs in submission order plus stats (`ticks` batched decode
     steps taken vs `solo_steps` a per-request loop would take; with a
     shared prefix, `saved_prefill_tokens` counts the K/V rows each
-    admission reused instead of recomputing). With `prefix_ids`, each
+    admission reused instead of recomputing). Stats is an
+    obs.ServerStats: the same dict plus attribute access and the
+    process metrics snapshot under `stats.metrics`. With `prefix_ids`, each
     prompt is the per-request SUFFIX and outputs cover suffix +
     generation (the prefix ids are not repeated in the result)."""
     srv = DecodeServer(
@@ -495,9 +547,10 @@ def serve_greedy(
         for (p, s), sp in zip(requests, samps)
     ]
     done = srv.run()
-    stats = {
-        "ticks": srv.ticks,
-        "solo_steps": srv.solo_steps,
-        "saved_prefill_tokens": srv.prefix_len * len(requests),
-    }
+    stats = ServerStats.snapshot(
+        srv.obs.registry,
+        ticks=srv.ticks,
+        solo_steps=srv.solo_steps,
+        saved_prefill_tokens=srv.prefix_len * len(requests),
+    )
     return [done[r] for r in rids], stats

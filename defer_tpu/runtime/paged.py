@@ -34,6 +34,8 @@ allocated blocks in one jitted op.
 
 from __future__ import annotations
 
+import hashlib
+import time
 from typing import Any
 
 import jax
@@ -41,6 +43,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from defer_tpu.obs.serving import ServerStats, ServingMetrics
 from defer_tpu.runtime.decode_server import SlotSampler
 from defer_tpu.runtime.stopping import matcher_or_none, normalize_stops
 
@@ -59,60 +62,108 @@ class PrefixBlockCache:
     later hit, evicted (key dropped, block returned to the caller's
     free list) only under allocation pressure. Only full blocks whose
     rows are all prompt content are ever registered — any block a
-    request will write generated tokens into stays private."""
+    request will write generated tokens into stays private.
 
-    def __init__(self):
+    Keys are CHAINED digests, not raw ancestry bytes: block j's key is
+    blake2b(key_{j-1} || block_j's own bs tokens), so a walk over n
+    full blocks hashes O(n * bs) bytes total instead of the
+    O(n^2 * bs) a per-block full-ancestry key costs on long prompts.
+    Because a digest could in principle collide, every hit is guarded
+    by an EXACT comparison of the candidate block's own token bytes
+    (`tok_of`): along a sequential walk the ancestor blocks were
+    already byte-verified, so by induction a guarded hit matches the
+    full ancestry — a false hit would need a genuine blake2b-128
+    collision AND identical own-block tokens.
+
+    `obs` — optional obs.serving.ServingMetrics whose prefix-cache
+    counters (parks / revivals / evictions) this cache drives; hit and
+    miss counts are the admitting server's job (it knows whether an
+    admission sticks)."""
+
+    def __init__(self, obs: Any = None):
         self.by_key: dict[bytes, int] = {}
         self.ref: dict[int, int] = {}
         self.key_of: dict[int, bytes] = {}
+        self.tok_of: dict[int, bytes] = {}  # own-block tokens (guard)
         self.lru: dict[int, None] = {}  # refcount-0 blocks, dict=LRU
+        self._obs = obs
 
     @staticmethod
-    def block_key(tokens: np.ndarray, j: int, bs: int) -> bytes:
-        """Ancestry key of block j: tokens[0 : (j+1)*bs]."""
-        return tokens[: (j + 1) * bs].astype(np.int64).tobytes()
+    def _hash(prev_key: bytes, block_bytes: bytes) -> bytes:
+        """One chain link: key_j = H(key_{j-1} || block_j bytes)."""
+        return hashlib.blake2b(
+            prev_key + block_bytes, digest_size=16
+        ).digest()
 
-    def lookup(self, tokens: np.ndarray, n_full: int, bs: int) -> list[int]:
-        """Leading-hit walk: pool blocks for blocks 0..k-1 where k is
-        the first miss among the n_full full prompt blocks. Bumps
-        refcounts (reviving LRU entries)."""
-        hits = []
+    def walk(
+        self, tokens: np.ndarray, n_full: int, bs: int
+    ) -> tuple[list[int], list[bytes], list[bytes]]:
+        """Leading-hit walk over the n_full full prompt blocks:
+        returns (hit pool blocks for blocks 0..k-1 where k is the
+        first miss, the chained key of EVERY full block, each block's
+        own token bytes). Keys/bytes for the miss tail feed
+        `register` after the owner prefills — computed here in the
+        same single O(n * bs) pass. Bumps refcounts on hits (reviving
+        LRU entries); a digest hit whose own-block tokens mismatch is
+        a collision, treated as a miss."""
+        flat = tokens[: n_full * bs].astype(np.int64)
+        keys: list[bytes] = []
+        toks: list[bytes] = []
+        prev = b""
         for j in range(n_full):
-            blk = self.by_key.get(self.block_key(tokens, j, bs))
-            if blk is None:
+            bb = flat[j * bs : (j + 1) * bs].tobytes()
+            prev = self._hash(prev, bb)
+            keys.append(prev)
+            toks.append(bb)
+        hits: list[int] = []
+        for j in range(n_full):
+            blk = self.by_key.get(keys[j])
+            if blk is None or self.tok_of[blk] != toks[j]:
                 break
             if self.ref[blk] == 0:
                 self.lru.pop(blk, None)
+                if self._obs is not None:
+                    self._obs.prefix_revivals.inc()
             self.ref[blk] += 1
             hits.append(blk)
-        return hits
+        return hits, keys, toks
 
     def register(
-        self, tokens: np.ndarray, j: int, bs: int, blk: int
+        self, key: bytes, block_bytes: bytes, blk: int
     ) -> int | None:
-        """Publish block j (freshly prefilled by its owner) for future
-        hits, with refcount 1 held by the registrant. Returns a
-        DISPLACED block to free, if this key was still cached from an
-        earlier, partially-evicted chain: the lookup walk stops at the
-        first miss, so a deeper same-key survivor is unreachable and
-        must be forgotten here — silently overwriting the maps would
-        leave its key_of entry aliasing the new block and corrupt a
-        later eviction. A displaced block is always refcount 0: any
-        ACTIVE holder of a deeper block also holds (and refcounts) the
-        whole chain above it, which would have made this key a hit.
+        """Publish a freshly prefilled full prompt block under its
+        chained `key` (from the same walk that missed it), with
+        refcount 1 held by the registrant. Returns a DISPLACED block
+        to free, if this key was still cached from an earlier,
+        partially-evicted chain: the walk stops at the first miss, so
+        a deeper same-key survivor is unreachable and must be
+        forgotten here — silently overwriting the maps would leave its
+        key_of entry aliasing the new block and corrupt a later
+        eviction. A displaced block is always refcount 0: any ACTIVE
+        holder of a deeper block also holds (and refcounts) the whole
+        chain above it, which would have made this key a hit.
         (Deepest-first parking in _finish makes shallow keys outlive
         deep ones, so this path should be unreachable — it stays as
-        defense for the invariant, asserted below.)"""
-        key = self.block_key(tokens, j, bs)
+        defense for the invariant, raising so the check survives
+        `python -O`.)"""
         displaced = self.by_key.get(key)
         if displaced is not None:
-            assert self.ref[displaced] == 0, (key, displaced)
+            if self.ref[displaced] != 0:
+                raise RuntimeError(
+                    f"prefix-cache invariant violated: key "
+                    f"{key.hex()} would displace block {displaced} "
+                    f"which still has {self.ref[displaced]} live "
+                    f"reference(s) — an active chain holder should "
+                    f"have made this key a hit"
+                )
             del self.lru[displaced]
             del self.ref[displaced]
             del self.key_of[displaced]
+            del self.tok_of[displaced]
         self.by_key[key] = blk
         self.ref[blk] = 1
         self.key_of[blk] = key
+        self.tok_of[blk] = block_bytes
         return displaced
 
     def release(self, blk: int) -> None:
@@ -121,6 +172,8 @@ class PrefixBlockCache:
         self.ref[blk] -= 1
         if self.ref[blk] == 0:
             self.lru[blk] = None
+            if self._obs is not None:
+                self._obs.prefix_parks.inc()
 
     def evict(self, n: int) -> list[int]:
         """Forget up to n least-recently-parked blocks; returns them
@@ -131,7 +184,10 @@ class PrefixBlockCache:
             del self.lru[blk]
             del self.by_key[self.key_of.pop(blk)]
             del self.ref[blk]
+            del self.tok_of[blk]
             out.append(blk)
+        if out and self._obs is not None:
+            self._obs.prefix_evictions.inc(len(out))
         return out
 
     @property
@@ -223,6 +279,11 @@ class PagedDecodeServer:
         self._next_id = 0
         self.ticks = 0
         self.blocks_peak = 0
+        # Metric handles resolved once; tick/admission paths touch
+        # pre-bound attributes only (obs/serving.py).
+        self.obs = ServingMetrics("paged")
+        self._submit_t: dict[int, float] = {}
+        self._last_tick_t: float | None = None
         self._step = None
         self._insert = None
         self._insert_dyn = None
@@ -244,7 +305,7 @@ class PagedDecodeServer:
                     "prefix_cache + multi-LoRA is unsupported: cached "
                     "prefix K/V would be adapter-dependent"
                 )
-            self.radix = PrefixBlockCache()
+            self.radix = PrefixBlockCache(obs=self.obs)
         if prefix_ids is not None:
             if self.multi_lora:
                 raise ValueError(
@@ -364,6 +425,7 @@ class PagedDecodeServer:
             (rid, prompt_ids, num_steps, adapter_id, sampling,
              stop_seqs)
         )
+        self._submit_t[rid] = time.perf_counter()
         return rid
 
     def _own_need(self, t0: int, steps: int) -> int:
@@ -585,7 +647,7 @@ class PagedDecodeServer:
         tokens = np.asarray(prompt)[0]
         n_full = t0 // bs
         total = -(-(t0 + steps) // bs)
-        hits = self.radix.lookup(tokens, n_full, bs)
+        hits, keys, toks = self.radix.walk(tokens, n_full, bs)
         need = total - len(hits)
         if need > len(self.free):
             self.free.extend(
@@ -596,6 +658,13 @@ class PagedDecodeServer:
                 self.radix.release(blk)
             return False
         own = [self.free.pop() for _ in range(need)]
+        self.obs.requests_admitted.inc()
+        self.obs.prefix_hits.inc(len(hits))
+        self.obs.prefix_misses.inc(n_full - len(hits))
+        self.obs.queue_wait.observe(
+            time.perf_counter()
+            - self._submit_t.get(rid, time.perf_counter())
+        )
         self._build()
         table_row = np.zeros((self.MB,), np.int32)
         for j, blk in enumerate(hits + own):
@@ -618,6 +687,7 @@ class PagedDecodeServer:
             small = self.dec.init_cache(1)
         suffix = prompt[:, suffix_pos:]
         ts = suffix.shape[1]
+        self.obs.prefill_tokens.inc(ts)
         pad = 1 << (ts - 1).bit_length()
         pad = min(pad, self.dec.cfg.max_len - suffix_pos)
         padded = jnp.concatenate(
@@ -641,7 +711,7 @@ class PagedDecodeServer:
         )
         for j in range(len(hits), n_full):
             displaced = self.radix.register(
-                tokens, j, bs, int(table_row[j])
+                keys[j], toks[j], int(table_row[j])
             )
             if displaced is not None:
                 self.free.append(displaced)
@@ -666,6 +736,11 @@ class PagedDecodeServer:
             "stop": matcher_or_none(stop_seqs),
         }
         self.slots[i] = slot
+        self.obs.ttft.observe(
+            time.perf_counter()
+            - self._submit_t.pop(rid, time.perf_counter())
+        )
+        self._update_pool_gauges()
         need_host = (
             self.eos_id is not None
             or self.on_token is not None
@@ -697,6 +772,12 @@ class PagedDecodeServer:
                 return  # pool exhausted: wait for a finisher
             self.pending.pop(0)
             blocks = [self.free.pop() for _ in range(need)]
+            self.obs.requests_admitted.inc()
+            self.obs.prefill_tokens.inc(t0)
+            self.obs.queue_wait.observe(
+                time.perf_counter()
+                - self._submit_t.get(rid, time.perf_counter())
+            )
             self._build()
             self.blocks_peak = max(
                 self.blocks_peak, self.blocks_in_use + need
@@ -753,6 +834,11 @@ class PagedDecodeServer:
                 "stop": matcher_or_none(stop_seqs),
             }
             self.slots[i] = slot
+            self.obs.ttft.observe(
+                time.perf_counter()
+                - self._submit_t.pop(rid, time.perf_counter())
+            )
+            self._update_pool_gauges()
             # Host transfer only when eos/streaming/stop matching
             # consumes the value (same guard as _tick) — the plain
             # path stays async.
@@ -796,6 +882,12 @@ class PagedDecodeServer:
             jnp.asarray(self.adapter.copy()),
         )
         self.ticks += 1
+        n_live = sum(live)
+        now = time.perf_counter()
+        if self._last_tick_t is not None:
+            self.obs.itl.observe(now - self._last_tick_t, n_live)
+        self._last_tick_t = now
+        self.obs.ticks.inc()
         if any(s is not None and s["sampling"] for s in self.slots):
             nxt = self._sampler.draw(logits[:, -1, :])
         else:
@@ -829,6 +921,7 @@ class PagedDecodeServer:
         token (admission first-token and every tick): `tok` is the
         host-side token value, or None when neither eos nor streaming
         needed the transfer."""
+        self.obs.tokens_generated.inc()
         if (
             self.eos_id is not None
             and tok is not None
@@ -846,8 +939,13 @@ class PagedDecodeServer:
         if slot["remaining"] == 0:
             self._finish(i)
 
+    def _update_pool_gauges(self) -> None:
+        self.obs.pool_blocks_free.set(len(self.free))
+        self.obs.pool_blocks_used.set(self.blocks_in_use)
+
     def _finish(self, i: int) -> None:
         slot = self.slots[i]
+        self.obs.requests_finished.inc()
         self.done[slot["rid"]] = jnp.concatenate(slot["toks"], axis=1)
         if self.radix is not None:
             # Shared blocks deref (parking at refcount 0 for later
@@ -862,6 +960,7 @@ class PagedDecodeServer:
         self.pos[i] = 0
         self.adapter[i] = 0
         self.slots[i] = None
+        self._update_pool_gauges()
 
 
 def serve_paged(
@@ -909,16 +1008,17 @@ def serve_paged(
         for (p, s), a, sp in zip(requests, aids, samps)
     ]
     done = srv.run()
-    stats = {
-        "ticks": srv.ticks,
-        "peak_blocks": srv.blocks_peak,
-        "pool_blocks": int(srv.pool_k.shape[1]) - 1,
-        "block_size": block_size,
-        "flat_equivalent_rows": max_batch * dec.cfg.max_len,
-        "shared_prefix_blocks": len(srv.shared_blocks),
-        "prefill_tokens_saved": srv.prefill_tokens_saved,
-        "cached_blocks": (
+    stats = ServerStats.snapshot(
+        srv.obs.registry,
+        ticks=srv.ticks,
+        peak_blocks=srv.blocks_peak,
+        pool_blocks=int(srv.pool_k.shape[1]) - 1,
+        block_size=block_size,
+        flat_equivalent_rows=max_batch * dec.cfg.max_len,
+        shared_prefix_blocks=len(srv.shared_blocks),
+        prefill_tokens_saved=srv.prefill_tokens_saved,
+        cached_blocks=(
             srv.radix.cached_blocks if srv.radix is not None else 0
         ),
-    }
+    )
     return [done[r] for r in rids], stats

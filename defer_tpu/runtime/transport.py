@@ -32,10 +32,33 @@ from typing import Iterator
 
 import numpy as np
 
+from defer_tpu.obs.metrics import get_registry
 from defer_tpu.runtime import codec
 from defer_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
+
+_reg = get_registry()
+_obs_tx_bytes = _reg.counter(
+    "defer_transport_tx_bytes_total", "Frame bytes written to the wire"
+)
+_obs_tx_frames = _reg.counter(
+    "defer_transport_tx_frames_total", "Array frames sent"
+)
+_obs_rx_bytes = _reg.counter(
+    "defer_transport_rx_bytes_total", "Frame bytes read off the wire"
+)
+_obs_rx_frames = _reg.counter(
+    "defer_transport_rx_frames_total", "Array frames received"
+)
+_obs_retries = _reg.counter(
+    "defer_transport_connect_retries_total",
+    "Failed connect attempts that were retried",
+)
+_obs_timeouts = _reg.counter(
+    "defer_transport_timeouts_total",
+    "Accept/connect timeouts surfaced as TransportError",
+)
 
 _TAG_ARRAY = b"A"
 _TAG_STOP = b"S"
@@ -95,8 +118,10 @@ class ArraySender:
                 break
             except OSError as e:
                 last = e
+                _obs_retries.inc()
                 threading.Event().wait(min(0.1 * 2**attempt, 2.0))
         else:
+            _obs_timeouts.inc()
             raise TransportError(
                 f"could not connect to {host}:{port}: {last}"
             )
@@ -127,6 +152,8 @@ class ArraySender:
             frame = codec.encode(a, level=level)
         with self._lock:
             self._sock.sendall(_HEADER.pack(_TAG_ARRAY, len(frame)) + frame)
+        _obs_tx_frames.inc()
+        _obs_tx_bytes.inc(_HEADER.size + len(frame))
 
     def close(self) -> None:
         """Send the STOP frame (the graceful shutdown the reference
@@ -167,6 +194,7 @@ class ArrayReceiver:
             try:
                 self._conn, peer = self._server.accept()
             except socket.timeout:
+                _obs_timeouts.inc()
                 raise TransportError(
                     "no peer connected within the accept timeout"
                 ) from None
@@ -182,6 +210,8 @@ class ArrayReceiver:
                 return
             if tag != _TAG_ARRAY:
                 raise TransportError(f"unknown frame tag {tag!r}")
+            _obs_rx_frames.inc()
+            _obs_rx_bytes.inc(_HEADER.size + length)
             yield codec.decode(_recv_exact(conn, length))
 
     def next_peer(self) -> None:

@@ -428,3 +428,77 @@ def test_stop_sequence_composes_with_sampling():
     got = np.asarray(srv.run()[r])[0]
     assert len(got) == 3 + first_end + 1, (got, base, stop)
     np.testing.assert_array_equal(got, base[: len(got)])
+
+
+def test_sample_token_batched_nosort_bit_identical():
+    """The sort-free sampler must be BITWISE equal to the general one
+    whenever top-k/top-p are disabled on every row — tokens and the
+    advanced key state both, so a server can switch variants
+    tick-by-tick (greedy rows, temperature spread, min_p floors)."""
+    from defer_tpu.models.gpt import (
+        sample_token_batched,
+        sample_token_batched_nosort,
+    )
+
+    B, V = 5, 97
+    logits = jax.random.normal(jax.random.key(3), (B, V)) * 4.0
+    keys = jax.random.split(jax.random.key(17), B)
+    temp = jnp.asarray([0.0, 0.7, 1.3, 1.0, 0.0], jnp.float32)
+    minp = jnp.asarray([0.0, 0.05, 0.0, 0.2, 0.1], jnp.float32)
+    zero_k = jnp.zeros((B,), jnp.int32)
+    one_p = jnp.ones((B,), jnp.float32)
+    want_t, want_k = sample_token_batched(
+        logits, keys, temp, zero_k, one_p, minp
+    )
+    got_t, got_k = sample_token_batched_nosort(logits, keys, temp, minp)
+    np.testing.assert_array_equal(np.asarray(got_t), np.asarray(want_t))
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.key_data(got_k)),
+        np.asarray(jax.random.key_data(want_k)),
+    )
+
+
+def test_nosort_dispatch_preserves_solo_parity():
+    """End-to-end: a server whose active slots all sample WITHOUT
+    top-k/top-p takes the sort-free draw every tick (row_sort stays
+    all-False), and each output still equals the solo reference
+    bit-for-bit; a top-k admission flips its slot's row_sort."""
+    from defer_tpu.models.gpt import SamplingParams
+
+    dec = tiny_gpt(64)
+    params = dec.init(jax.random.key(0))
+    reqs = _requests(dec.cfg.vocab_size)[:3]
+    samps = [
+        SamplingParams(temperature=0.9, seed=11),
+        None,  # greedy neighbor shares ticks with the sampled rows
+        SamplingParams(temperature=1.2, min_p=0.1, seed=4),
+    ]
+    srv = DecodeServer(dec, params, max_batch=2)
+    rids = [
+        srv.submit(p, s, sampling=sp)
+        for (p, s), sp in zip(reqs, samps)
+    ]
+    done = srv.run()
+    assert not any(srv._sampler.row_sort)
+    for (p, s), sp, r in zip(reqs, samps, rids):
+        want = _solo_reference(dec, params, p, s, sp)
+        np.testing.assert_array_equal(
+            np.asarray(done[r]), np.asarray(want)
+        )
+
+    srv2 = DecodeServer(dec, params, max_batch=2)
+    r_sorted = srv2.submit(
+        reqs[0][0], 3,
+        sampling=SamplingParams(temperature=1.0, top_k=5, seed=1),
+    )
+    done2 = srv2.run()
+    assert any(srv2._sampler.row_sort)
+    np.testing.assert_array_equal(
+        np.asarray(done2[r_sorted]),
+        np.asarray(
+            _solo_reference(
+                dec, params, reqs[0][0], 3,
+                SamplingParams(temperature=1.0, top_k=5, seed=1),
+            )
+        ),
+    )

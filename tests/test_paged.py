@@ -399,3 +399,62 @@ def test_radix_validation():
             prefix_cache=True,
             prefix_ids=jnp.zeros((1, 4), jnp.int32),
         )
+
+
+# -- PrefixBlockCache unit semantics (chained keys, invariants) -------
+
+
+def test_prefix_cache_register_refuses_live_displacement():
+    """Displacing a block that still has live references is an
+    invariant violation (any active holder of the deeper chain should
+    have made the key a hit) — register must raise, not corrupt the
+    maps; at refcount 0 the displacement succeeds and hands the old
+    block back for the free list."""
+    from defer_tpu.runtime.paged import PrefixBlockCache
+
+    c = PrefixBlockCache()
+    bb = np.arange(4, dtype=np.int64).tobytes()
+    key = PrefixBlockCache._hash(b"", bb)
+    c.register(key, bb, 5)  # refcount 1, held by the registrant
+    with pytest.raises(RuntimeError, match="live reference"):
+        c.register(key, bb, 7)
+    c.release(5)  # parks block 5 at refcount 0
+    assert c.register(key, bb, 7) == 5
+    assert c.by_key[key] == 7 and c.ref[7] == 1 and 5 not in c.ref
+
+
+def test_prefix_cache_collision_guard(monkeypatch):
+    """Force every chained digest to collide: a walk over DIFFERENT
+    tokens must still miss (the own-block byte compare), and the
+    genuine tokens must still hit."""
+    from defer_tpu.runtime.paged import PrefixBlockCache
+
+    monkeypatch.setattr(
+        PrefixBlockCache, "_hash", staticmethod(lambda prev, bb: b"X")
+    )
+    c = PrefixBlockCache()
+    t1 = np.asarray([1, 2, 3, 4], np.int64)
+    t2 = np.asarray([9, 9, 9, 9], np.int64)
+    hits, keys, toks = c.walk(t1, 1, 4)
+    assert hits == []
+    c.register(keys[0], toks[0], 3)
+    assert c.walk(t2, 1, 4)[0] == []  # digest equal, bytes differ
+    c.release(3)
+    assert c.walk(t1, 1, 4)[0] == [3]  # true match hits (and revives)
+
+
+def test_prefix_cache_keys_encode_ancestry():
+    """Chained keys depend on the whole ancestry, not just the
+    block's own tokens: block 1 of one prompt never aliases block 0
+    of another even with identical own-token bytes, while a shared
+    leading block keys identically from either prompt."""
+    from defer_tpu.runtime.paged import PrefixBlockCache
+
+    c = PrefixBlockCache()
+    a = np.asarray([1, 2, 3, 4, 5, 6, 7, 8], np.int64)
+    b = np.asarray([5, 6, 7, 8], np.int64)  # == a's second block
+    _, ka, ta = c.walk(a, 2, 4)
+    _, kb, tb = c.walk(b, 1, 4)
+    assert ta[1] == tb[0]  # same own bytes ...
+    assert ka[1] != kb[0]  # ... different ancestry, different key
+    assert ka[0] == c.walk(a[:4], 1, 4)[1][0]  # prefix-stable
