@@ -522,6 +522,27 @@ def bench_paged_server(devices) -> dict:
     return rec
 
 
+def bench_paged_attention(devices) -> dict:
+    """Paged-decode attention modes (scripts/bench_paged.py): the same
+    request mix through gathered vs block-native attention, pricing
+    tokens/sec and the per-tick K/V rows actually read. The ratio is
+    the bandwidth story; the obs counters make it exact."""
+    import importlib.util
+    import os
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "scripts",
+        "bench_paged.py",
+    )
+    spec = importlib.util.spec_from_file_location("bench_paged", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rec = mod.run_microbench(devices)
+    log(f"paged attention modes: {rec}")
+    return rec
+
+
 def bench_bert(devices) -> dict:
     """Single-chip SPMD BERT-base forward throughput + MFU."""
     import jax
@@ -894,6 +915,7 @@ def run_bench() -> dict:
             ("llama_decode", bench_llama_decode),
             ("decode_server", bench_decode_server),
             ("paged_server", bench_paged_server),
+            ("paged_attention", bench_paged_attention),
             ("bert_base", bench_bert),
         ]
         # Mosaic-kernel section last. It runs wherever the pallas gate

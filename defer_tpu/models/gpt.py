@@ -536,6 +536,94 @@ class GptDecoder:
         dh = self.cfg.dim // self.cfg.num_heads
         return x.reshape(b, t, d // dh, dh).transpose(0, 2, 1, 3)
 
+    def _proj_fns(self, p: dict, dt, adapter_ids=None):
+        """The (bias, proj) closures every block stage shares —
+        factored out so the paged block-native steps
+        (runtime/paged.py) run the EXACT projection code `_block`
+        runs, not a reimplementation."""
+        from defer_tpu.models.quant import dequantize_leaf
+
+        def W(name):
+            # Plain bf16/fp32 matrices pass through; int8-quantized
+            # leaves ({"q","s"}, models/quant.py) widen here and XLA
+            # fuses the dequant into the matmul (HBM reads stay int8).
+            return dequantize_leaf(p[name], dt)
+
+        def bias(h, name):
+            return h + p[name].astype(dt) if name in p else h
+
+        def proj(h, name):
+            """Base matmul plus, in multi-LoRA serving, each batch
+            row's OWN adapter delta: the per-layer adapter banks
+            ({name}:a [A, in, r] / {name}:b [A, r, out], pre-scaled —
+            parallel/lora.py::stack_adapters) are gathered by the
+            slot's adapter id, so one weight read serves every tenant
+            and only the two skinny per-row einsums differ."""
+            y = h @ W(name)
+            a = p.get(f"{name}:a")
+            if a is not None and adapter_ids is not None:
+                a_sel = a[adapter_ids].astype(dt)  # [B, in, r]
+                b_sel = p[f"{name}:b"][adapter_ids].astype(dt)
+                low = jnp.einsum("btd,bdr->btr", h, a_sel)
+                y = y + jnp.einsum("btr,bro->bto", low, b_sel)
+            return y
+
+        return bias, proj
+
+    def _attn_qkv(self, p: dict, x, pos, adapter_ids=None):
+        """ln1 + q/k/v projections (+rope at the step's absolute
+        positions) + head split: everything a block does BEFORE the
+        cache layout matters. Returns (q [B,Hq,T,Dh], k, v
+        [B,Hkv,T,Dh]). Shared verbatim by `_block` and the paged
+        block-native steps so their new K/V rows are bit-identical."""
+        cfg = self.cfg
+        dt = x.dtype
+        dh = cfg.dim // cfg.num_heads
+        per_slot = getattr(pos, "ndim", 0) == 1
+        bias, proj = self._proj_fns(p, dt, adapter_ids)
+        h = norm_apply(cfg, x, p, "ln1")
+        qf = bias(proj(h, "wq"), "bq")
+        kf = bias(proj(h, "wk"), "bk")
+        vf = bias(proj(h, "wv"), "bv")
+        if cfg.pos_style == "rope":
+            steps_r = jnp.arange(qf.shape[1])
+            positions = (
+                pos[:, None] + steps_r[None] if per_slot else pos + steps_r
+            )
+            qf = apply_rope(qf, dh, positions, cfg.rope_theta)
+            kf = apply_rope(kf, dh, positions, cfg.rope_theta)
+        return (
+            self._split_heads(qf),
+            self._split_heads(kf),
+            self._split_heads(vf),
+        )
+
+    def _attn_out(self, p: dict, x, attn, tp_axis=None, adapter_ids=None):
+        """Everything a block does AFTER attention: wo projection
+        (+psum under tp), residual, ln2, FFN. `attn` is the merged
+        [B, T, Hq*Dh] attention output. Shared by `_block` and the
+        paged block-native steps."""
+        cfg = self.cfg
+        bias, proj = self._proj_fns(p, x.dtype, adapter_ids)
+        attn = proj(attn, "wo")
+        if tp_axis is not None:
+            attn = lax.psum(attn, tp_axis)
+        attn = bias(attn, "bo")
+        x = x + attn
+        h2 = norm_apply(cfg, x, p, "ln2")
+        if cfg.ffn_style == "swiglu":
+            gate = jax.nn.silu(proj(h2, "w1"))
+            ff = proj(gate * proj(h2, "w3"), "w2")
+            if tp_axis is not None:
+                ff = lax.psum(ff, tp_axis)
+            return x + ff
+        ff = bias(proj(h2, "w1"), "b1")
+        ff = jax.nn.gelu(ff)
+        ff = proj(ff, "w2")
+        if tp_axis is not None:
+            ff = lax.psum(ff, tp_axis)
+        return bias(x + ff, "b2")
+
     def _block(
         self,
         p: dict,
@@ -565,47 +653,7 @@ class GptDecoder:
         dt = x.dtype
         dh = cfg.dim // cfg.num_heads
         per_slot = getattr(pos, "ndim", 0) == 1
-        from defer_tpu.models.quant import dequantize_leaf
-
-        def W(name):
-            # Plain bf16/fp32 matrices pass through; int8-quantized
-            # leaves ({"q","s"}, models/quant.py) widen here and XLA
-            # fuses the dequant into the matmul (HBM reads stay int8).
-            return dequantize_leaf(p[name], dt)
-
-        def bias(h, name):
-            return h + p[name].astype(dt) if name in p else h
-
-        def proj(h, name):
-            """Base matmul plus, in multi-LoRA serving, each batch
-            row's OWN adapter delta: the per-layer adapter banks
-            ({name}:a [A, in, r] / {name}:b [A, r, out], pre-scaled —
-            parallel/lora.py::stack_adapters) are gathered by the
-            slot's adapter id, so one weight read serves every tenant
-            and only the two skinny per-row einsums differ."""
-            y = h @ W(name)
-            a = p.get(f"{name}:a")
-            if a is not None and adapter_ids is not None:
-                a_sel = a[adapter_ids].astype(dt)  # [B, in, r]
-                b_sel = p[f"{name}:b"][adapter_ids].astype(dt)
-                low = jnp.einsum("btd,bdr->btr", h, a_sel)
-                y = y + jnp.einsum("btr,bro->bto", low, b_sel)
-            return y
-
-        h = norm_apply(cfg, x, p, "ln1")
-        qf = bias(proj(h, "wq"), "bq")
-        kf = bias(proj(h, "wk"), "bk")
-        vf = bias(proj(h, "wv"), "bv")
-        if cfg.pos_style == "rope":
-            steps_r = jnp.arange(qf.shape[1])
-            positions = (
-                pos[:, None] + steps_r[None] if per_slot else pos + steps_r
-            )
-            qf = apply_rope(qf, dh, positions, cfg.rope_theta)
-            kf = apply_rope(kf, dh, positions, cfg.rope_theta)
-        q = self._split_heads(qf)
-        k = self._split_heads(kf)
-        v = self._split_heads(vf)
+        q, k, v = self._attn_qkv(p, x, pos, adapter_ids)
         b, h_q, t, _ = q.shape
 
         if self.rolling_cache:
@@ -750,24 +798,8 @@ class GptDecoder:
             attn = jnp.einsum("bkgts,bksd->bkgtd", weights, v_att)
             attn = attn.reshape(b, h_q, t, dh)
             attn = attn.transpose(0, 2, 1, 3).reshape(b, t, h_q * dh)
-        attn = proj(attn, "wo")
-        if tp_axis is not None:
-            attn = lax.psum(attn, tp_axis)
-        attn = bias(attn, "bo")
-        x = x + attn
-        h2 = norm_apply(cfg, x, p, "ln2")
-        if cfg.ffn_style == "swiglu":
-            gate = jax.nn.silu(proj(h2, "w1"))
-            ff = proj(gate * proj(h2, "w3"), "w2")
-            if tp_axis is not None:
-                ff = lax.psum(ff, tp_axis)
-            return x + ff, k_cache, v_cache
-        ff = bias(proj(h2, "w1"), "b1")
-        ff = jax.nn.gelu(ff)
-        ff = proj(ff, "w2")
-        if tp_axis is not None:
-            ff = lax.psum(ff, tp_axis)
-        return bias(x + ff, "b2"), k_cache, v_cache
+        out = self._attn_out(p, x, attn, tp_axis, adapter_ids)
+        return out, k_cache, v_cache
 
     def _step_fn(self, tp_axis: str | None = None):
         """The ONE step body (embed -> scan over blocks -> final LN ->

@@ -11,6 +11,7 @@ from defer_tpu.obs.metrics import (
     Gauge,
     Histogram,
     MetricsRegistry,
+    counter_deltas,
     get_registry,
     log_buckets,
     reset,
@@ -26,6 +27,7 @@ __all__ = [
     "PeriodicDumper",
     "ServerStats",
     "ServingMetrics",
+    "counter_deltas",
     "get_registry",
     "log_buckets",
     "prometheus_text",

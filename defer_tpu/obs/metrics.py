@@ -31,6 +31,7 @@ Design constraints, in order:
 from __future__ import annotations
 
 import bisect
+import contextlib
 import threading
 from typing import Any
 
@@ -303,6 +304,40 @@ class MetricsRegistry:
         from defer_tpu.obs.export import prometheus_text
 
         return prometheus_text(self)
+
+
+@contextlib.contextmanager
+def counter_deltas(registry: MetricsRegistry | None = None):
+    """Counter INCREMENTS across a with-block, as
+    {prometheus sample name: delta}.
+
+    The registry is process-global and cumulative (reset() exists for
+    test isolation, but resetting mid-flight would zero instruments a
+    live server is still driving), so "how much did THIS run read?"
+    needs a before/after diff. Yields a dict that is empty inside the
+    block and populated on exit with every counter whose value grew —
+    counters created during the block diff against a baseline of 0.
+
+        with counter_deltas() as d:
+            serve_paged(...)
+        d['defer_kv_rows_read_total{server="paged"}']
+    """
+    from defer_tpu.obs.export import sample_name
+
+    reg = registry if registry is not None else _REGISTRY
+    before = {
+        (m.name, _label_key(m.labels)): m._snapshot()
+        for m in reg
+        if isinstance(m, Counter)
+    }
+    out: dict[str, float] = {}
+    yield out
+    for m in reg:
+        if not isinstance(m, Counter):
+            continue
+        d = m._snapshot() - before.get((m.name, _label_key(m.labels)), 0)
+        if d:
+            out[sample_name(m.name, m.labels)] = d
 
 
 _REGISTRY = MetricsRegistry()

@@ -101,6 +101,28 @@ class ServingMetrics:
             "defer_prefix_cache_revivals_total",
             "Parked cache blocks revived by a new sharer", labels,
         )
+        # Block-native attention accounting (runtime/paged.py): rows
+        # the tick's attention path actually read vs what the gathered
+        # full-pool-view path reads regardless of depth. One unit =
+        # one K/V row pair (token position) for one slot for one tick,
+        # layer/head-agnostic — multiply by 2 * L * Hkv * Dh * itemsize
+        # for bytes. The ratio read/baseline is the bandwidth win.
+        self.kv_rows_read = reg.counter(
+            "defer_kv_rows_read_total",
+            "KV cache rows (token positions, K+V pair = 1 unit, "
+            "layer-agnostic) read by decode-tick attention, summed "
+            "over slots", labels,
+        )
+        self.kv_rows_gathered = reg.counter(
+            "defer_kv_rows_gathered_baseline_total",
+            "Rows the gathered full-pool-view path would have read "
+            "for the same ticks (B * max_blocks * block_size each)",
+            labels,
+        )
+        self.kv_rows_last = reg.gauge(
+            "defer_kv_rows_read_last_tick",
+            "KV rows read by the most recent decode tick", labels,
+        )
 
 
 class ServerStats(dict):

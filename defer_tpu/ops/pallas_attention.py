@@ -347,6 +347,169 @@ def flash_decode(
     return out[:, :, :g, :].reshape(b, hq, d)
 
 
+def _paged_decode_kernel(
+    tables_ref,
+    pos_ref,
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    m_scr,
+    l_scr,
+    acc_scr,
+    *,
+    sm_scale: float,
+    block_size: int,
+    window: int | None,
+    num_tb: int,
+):
+    """One (batch, kv-head, table-column) cell of paged flash-decode:
+    like `_decode_kernel`, but the K/V tile staged for column `tb` is
+    whatever POOL block the slot's table names — the index maps do the
+    block-table indirection, so the kernel never sees a contiguous
+    cache and nothing is gathered in HBM. Dead columns (wholly outside
+    [pos-window+1, pos]) are compute-gated off here AND clamped onto a
+    live column's pool block in the index maps, so per slot only its
+    LIVE blocks are ever fetched — the bandwidth contract the paged
+    pool exists for. Unallocated table entries point at trash block 0
+    (runtime/paged.py invariant); the clamp keeps them un-fetched and
+    the position mask keeps block-`hi` rows past `pos` unattended."""
+    tb = pl.program_id(2)
+    p_b = pos_ref[pl.program_id(0)]
+    lo, hi = _decode_lo_hi(p_b, block_size, window)
+
+    @pl.when(tb == 0)
+    def _init():
+        m_scr[:] = jnp.full(m_scr.shape, _MASK_VALUE, jnp.float32)
+        l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    @pl.when((tb >= lo) & (tb <= hi))
+    def _fold():
+        q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # (G, d)
+        g = q.shape[0]
+        k = k_ref[0, 0].astype(jnp.float32)  # (block_size, d)
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = lax.dot_general(
+            q,
+            k,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (G, block_size)
+        cols = tb * block_size + lax.broadcasted_iota(
+            jnp.int32, (g, block_size), 1
+        )
+        mask = cols <= p_b
+        if window is not None:
+            mask &= cols > p_b - window
+        s = jnp.where(mask, s, _MASK_VALUE)
+        m = m_scr[:]
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        m_scr[:] = m_new
+        l_scr[:] = l_scr[:] * alpha + p.sum(axis=-1)
+        acc_scr[:] = acc_scr[:] * alpha[:, None] + lax.dot_general(
+            p,
+            v,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(tb == num_tb - 1)
+    def _emit():
+        o_ref[0, 0] = (acc_scr[:] / l_scr[:][:, None]).astype(
+            o_ref.dtype
+        )
+
+
+def paged_flash_decode(
+    q: jax.Array,
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    tables: jax.Array,
+    pos: jax.Array,
+    *,
+    window: int | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Paged flash-decode: one query token per slot attending its
+    BLOCK TABLE directly — no contiguous [B, Hkv, MB*bs, Dh] gather
+    ever exists in HBM (the gather is runtime/paged.py's gathered-path
+    cost this kernel deletes).
+
+    q [B, Hq, Dh]; pool_k/pool_v [NB, Hkv, bs, Dh] — ONE layer of the
+    shared block pool; tables [B, MB] int32 pool indices (unallocated
+    entries = trash block 0); pos [B] int32 = each slot's last valid
+    key, INCLUSIVE. Returns [B, Hq, Dh].
+
+    Tables and positions ride scalar prefetch (SMEM): the K/V index
+    maps resolve column tb of slot i to pool block tables[i, tb],
+    clamped into the slot's live range so dead columns re-stage an
+    already-resident tile instead of DMAing trash — per-slot bandwidth
+    is O(live blocks), the paged-attention point. Query groups
+    narrower than 8 rows are zero-padded to the TPU sublane tile and
+    sliced back."""
+    b, hq, d = q.shape
+    nb, hkv, bs, _ = pool_k.shape
+    if hq % hkv:
+        raise ValueError(f"Hq={hq} must be a multiple of Hkv={hkv}")
+    if tables.ndim != 2 or tables.shape[0] != b:
+        raise ValueError(
+            f"tables must be [B={b}, MB], got {tables.shape}"
+        )
+    g = hq // hkv
+    mb = tables.shape[1]
+    g_pad = max(g, 8)
+    qg = q.reshape(b, hkv, g, d)
+    if g_pad != g:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, g_pad - g), (0, 0)))
+    tables = jnp.asarray(tables, jnp.int32)
+    pos1 = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1), (b,))
+    kernel = functools.partial(
+        _paged_decode_kernel,
+        sm_scale=d**-0.5,
+        block_size=bs,
+        window=window,
+        num_tb=mb,
+    )
+
+    def kv_index(i, j, tb, tables_ref, pos_ref):
+        lo, hi = _decode_lo_hi(pos_ref[i], bs, window)
+        return (tables_ref[i, jnp.clip(tb, lo, hi)], j, 0, 0)
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, hkv, mb),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, g_pad, d),
+                lambda i, j, tb, tables_ref, pos_ref: (i, j, 0, 0),
+            ),
+            pl.BlockSpec((1, 1, bs, d), kv_index),
+            pl.BlockSpec((1, 1, bs, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, g_pad, d),
+            lambda i, j, tb, tables_ref, pos_ref: (i, j, 0, 0),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((g_pad,), jnp.float32),
+            pltpu.VMEM((g_pad,), jnp.float32),
+            pltpu.VMEM((g_pad, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, g_pad, d), q.dtype),
+        interpret=interpret,
+    )(tables, pos1, qg, pool_k, pool_v)
+    return out[:, :, :g, :].reshape(b, hq, d)
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,

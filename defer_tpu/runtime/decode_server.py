@@ -88,9 +88,10 @@ class SlotSampler:
         # Host mirror of "this row's policy needs the sorting filters"
         # (top_k or top_p enabled). While no admitted row does, draw()
         # routes through the sort-free tick variant — same bits, no
-        # O(V log V) sorts. Rows are only ever set at admission, so a
-        # finished sorting slot keeps its True until the slot is
-        # reused: conservatively correct (slow path, same output).
+        # O(V log V) sorts. Rows are set at admission and cleared by
+        # release() the moment the slot finishes, so one top-k request
+        # costs the batch the sorting path only while it is actually
+        # live.
         self.row_sort = [False] * max_batch
 
     def admit_first(self, i, samp, logits_row, dtype):
@@ -121,6 +122,21 @@ class SlotSampler:
         self.row_temp[i] = samp.temperature
         self.row_sort[i] = samp.top_k > 0 or samp.top_p < 1.0
         return tok[:, None].astype(dtype)
+
+    def release(self, i: int) -> None:
+        """Retire slot i's sampling policy the moment its request
+        FINISHES (both servers' _finish), not when the slot is next
+        reused: a stale row_sort=True would keep routing every tick
+        through the sorting sampler long after the top-k request is
+        gone, and a stale temperature would route the idle row's dummy
+        draw through the categorical path. Greedy rows (temp 0, no
+        sort) are already released — the common case stays free of
+        device writes. Idle rows' keys keep advancing in draw(), which
+        is fine: admission re-seeds them."""
+        self.row_sort[i] = False
+        if self.row_temp[i] != 0.0:
+            self.temp = self.temp.at[i].set(0.0)
+            self.row_temp[i] = 0.0
 
     def draw(self, logits_last):
         """One batched draw over every slot's policy (B,): sampled
@@ -221,6 +237,13 @@ class DecodeServer:
             _, pre = self.step(params, pre, prefix_ids)
             self._prefix_cache = pre
         self.slots = [_Slot() for _ in range(max_batch)]
+        # Persistent tick feed: each slot's next input token lives in
+        # row i, updated by .at[i].set at admission and one
+        # full-vector write after each draw — not rebuilt by
+        # concatenating max_batch [1,1] arrays every tick (host
+        # dispatch overhead that dominates at small models). Idle
+        # rows are dummies.
+        self._feed = jnp.zeros((max_batch, 1), jnp.int32)
         self._sampler = SlotSampler(max_batch)
         self.pending: list[tuple] = []
         self.done: dict[int, jax.Array] = {}
@@ -421,6 +444,7 @@ class DecodeServer:
         slot.toks = [prompt, first]
         slot.sampling = samp is not None
         slot.stop = matcher_or_none(stop_seqs)
+        self._feed = self._feed.at[i].set(first[0].astype(jnp.int32))
         need_host = (
             self.eos_id is not None
             or self.on_token is not None
@@ -434,22 +458,15 @@ class DecodeServer:
         if self.on_token is not None:
             self.on_token(rid, tok_host, slot.remaining == 0)
         if slot.remaining == 0:
-            self._finish(slot)
+            self._finish(i, slot)
 
     def _tick(self) -> None:
         active = [s.req is not None for s in self.slots]
         if not any(active):
             return
-        feed = jnp.concatenate(
-            [
-                s.last
-                if s.req is not None
-                else jnp.zeros((1, 1), jnp.int32)
-                for s in self.slots
-            ],
-            axis=0,
-        )
-        logits, cache = self.step(self.params, self.cache, feed)
+        # Persistent [B,1] device feed (constructor note): admissions
+        # set their row, draws below overwrite the whole vector.
+        logits, cache = self.step(self.params, self.cache, self._feed)
         self.ticks += 1
         n_active = sum(active)
         now = time.perf_counter()
@@ -469,6 +486,7 @@ class DecodeServer:
             nxt = self._sampler.draw(logits[:, -1, :])
         else:
             nxt = jnp.argmax(logits[:, -1, :], axis=-1)  # (B,)
+        self._feed = nxt[:, None].astype(jnp.int32)
         # One device->host transfer per tick for streaming/eos/stop
         # matching, not one blocking int() per slot.
         need_host = (
@@ -501,9 +519,9 @@ class DecodeServer:
                     slot.req, int(host_nxt[i]), slot.remaining == 0
                 )
             if slot.remaining == 0:
-                self._finish(slot)
+                self._finish(i, slot)
 
-    def _finish(self, slot: _Slot) -> None:
+    def _finish(self, i: int, slot: _Slot) -> None:
         self.obs.requests_finished.inc()
         self.done[slot.req] = jnp.concatenate(slot.toks, axis=1)
         slot.req = None
@@ -511,6 +529,10 @@ class DecodeServer:
         slot.last = None
         slot.sampling = False
         slot.stop = None
+        # Release the slot's sampling policy row NOW, not at reuse —
+        # a lingering row_sort would drag every later tick through
+        # the sorting sampler (SlotSampler.release).
+        self._sampler.release(i)
 
 
 def serve_greedy(

@@ -11,15 +11,51 @@ budgets, not slots x max_len.
 
 Static-shape design (everything jits once):
 
-  * the decode step gathers each slot's blocks into the standard
-    contiguous [B, H_kv, S, Dh] view (one gather per layer) and runs
-    the EXACT SAME block math as the flat decoder (GptDecoder._block)
-    — numerical parity is inherited, not re-proven — then scatters the
-    single new K/V row back to its block;
+  * the decode step runs one of THREE attention paths, selected by
+    `attention=` (default "gathered"):
+
+      - "gathered": gather each slot's blocks into the standard
+        contiguous [B, H_kv, S, Dh] view (one gather per layer) and
+        run the EXACT SAME block math as the flat decoder
+        (GptDecoder._block) — numerical parity is inherited, not
+        re-proven (bit-exact vs the flat server at tested scales) —
+        then scatter the single new K/V row back to its block. Per
+        tick it reads O(B * max_blocks * block_size) rows regardless
+        of request depth: the reference path, and the baseline the
+        others are measured against.
+      - "blockwise": attend THROUGH the block table — scatter the new
+        K/V row into the pool first, then fold pool blocks into an
+        online-softmax carry (running max / denominator,
+        flash-attention recurrence) one table column at a time,
+        stopping at the deepest LIVE block across the batch
+        (`lax.fori_loop` with a traced bound). Pure XLA, runs
+        everywhere CPU tier-1 runs. Reads O(B * live_blocks *
+        block_size) rows per tick. Parity contract: TIE-TOLERANT —
+        the projections/FFN are `_block`'s own code (bit-identical),
+        but the softmax reduction order differs, so logits agree only
+        to float tolerance; at tested scales the emitted tokens are
+        identical (tests pin that), while near-ties could in
+        principle resolve differently.
+      - "pallas": the block-table-indexed flash-decode kernel
+        (ops/pallas_attention.py::paged_flash_decode) — the table
+        indirection happens in the kernel's index maps, dead columns
+        are clamped so each slot DMAs only ITS OWN live blocks:
+        per-slot bandwidth O(own live blocks), the full
+        paged-attention win. Runs natively on TPU (Mosaic), and
+        through the pallas interpreter anywhere else (slow; CI
+        exercises it under the `slow` marker). Same tie-tolerant
+        contract as "blockwise".
+
+    The win is observable: `defer_kv_rows_read_total` vs
+    `defer_kv_rows_gathered_baseline_total` (obs/serving.py) count
+    per-tick rows read vs the gathered baseline, and
+    scripts/bench_paged.py benches all modes side by side;
   * block tables are a fixed [B, max_blocks] shape; unallocated
     entries point at the reserved TRASH block 0 (never allocated to a
     request), so out-of-budget writes land in scrap instead of another
-    request's memory and garbage reads sit beyond the position mask;
+    request's memory and garbage reads sit beyond the position mask —
+    every attention path keeps this invariant and the
+    scatter-new-row write unchanged;
   * allocation is host-side and exact: a request's block need is known
     at submit time (prompt + step budget, eos can only shorten it), so
     admission takes ceil(total/block_size) blocks from the free list
@@ -44,8 +80,64 @@ import numpy as np
 from jax import lax
 
 from defer_tpu.obs.serving import ServerStats, ServingMetrics
+from defer_tpu.ops.pallas_attention import _MASK_VALUE
 from defer_tpu.runtime.decode_server import SlotSampler
 from defer_tpu.runtime.stopping import matcher_or_none, normalize_stops
+
+
+def _blockwise_attend(q, pk_l, pv_l, tables, pos, bs, nb_live, window):
+    """Single-token attention THROUGH a block table: fold pool blocks
+    into the online-softmax carry (running max m, denominator l,
+    accumulator — the flash recurrence, in fp32) one table column at a
+    time, `lax.fori_loop`ed to `nb_live` = the deepest live block
+    across the batch, so reads stop at actual depth instead of pool
+    width. Per column the gather touches B blocks (one per slot); a
+    slot shallower than the column has its whole block masked (its
+    table entry points at live-or-trash rows the position mask
+    excludes), which is what keeps the trash-block-0 invariant safe
+    here. GQA folds grouped, [B, Hkv, G, *] against the [B, Hkv, bs,
+    Dh] block — same head-major grouping as GptDecoder._block.
+
+    q [B, Hq, 1, Dh]; pk_l/pv_l [NB, Hkv, bs, Dh]; tables [B, MB];
+    pos [B] inclusive last valid key. Returns [B, 1, Hq*Dh] in
+    q.dtype. Numerics: the recurrence computes the same softmax as
+    the gathered path's one-pass einsum up to reduction order —
+    tie-tolerant, not bit-exact (module docstring)."""
+    b, hq, _, dh = q.shape
+    hkv = pk_l.shape[1]
+    g = hq // hkv
+    qg = q[:, :, 0, :].reshape(b, hkv, g, dh).astype(jnp.float32)
+    qg = qg * (dh**-0.5)
+    span = jnp.arange(bs)
+
+    def body(j, carry):
+        m, l, acc = carry
+        blk = tables[:, j]  # [B]
+        k = pk_l[blk].astype(jnp.float32)  # [B, Hkv, bs, Dh]
+        v = pv_l[blk].astype(jnp.float32)
+        s = jnp.einsum("bkgd,bksd->bkgs", qg, k)
+        cols = j * bs + span  # [bs]
+        mask = cols[None, :] <= pos[:, None]  # [B, bs]
+        if window is not None:
+            mask &= cols[None, :] > pos[:, None] - window
+        s = jnp.where(mask[:, None, None, :], s, _MASK_VALUE)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l_new = l * alpha + p.sum(axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bkgs,bksd->bkgd", p, v
+        )
+        return m_new, l_new, acc_new
+
+    init = (
+        jnp.full((b, hkv, g), _MASK_VALUE, jnp.float32),
+        jnp.zeros((b, hkv, g), jnp.float32),
+        jnp.zeros((b, hkv, g, dh), jnp.float32),
+    )
+    _, l, acc = lax.fori_loop(0, nb_live, body, init)
+    out = acc / l[..., None]  # [B, Hkv, G, Dh]
+    return out.astype(q.dtype).reshape(b, 1, hq * dh)
 
 
 class PrefixBlockCache:
@@ -225,9 +317,17 @@ class PagedDecodeServer:
         on_token: Any = None,
         prefix_ids: jax.Array | None = None,
         prefix_cache: bool = False,
+        attention: str = "gathered",
     ):
         """`on_token(request_id, token_id, done)` — optional streaming
         callback, same contract as the flat server's.
+
+        `attention` — which decode attention path the tick compiles
+        (module docstring): "gathered" (contiguous-view reference,
+        bit-exact, the default), "blockwise" (pure-XLA block-native,
+        reads stop at the deepest live block, tie-tolerant), or
+        "pallas" (block-table-indexed kernel, per-slot live-block
+        DMA; interpret-mode fallback off-TPU, tie-tolerant).
 
         `prefix_ids` [1, P] — SHARED-prefix paging: the system
         prompt's K/V blocks are allocated ONCE and every request's
@@ -252,6 +352,12 @@ class PagedDecodeServer:
                 f"need block_size >= 1 and num_blocks >= 2 (one trash "
                 f"block + one usable), got {block_size}/{num_blocks}"
             )
+        if attention not in ("gathered", "blockwise", "pallas"):
+            raise ValueError(
+                f"attention must be 'gathered', 'blockwise' or "
+                f"'pallas', got {attention!r}"
+            )
+        self.attention = attention
         self.dec = dec
         self.params = params
         self.B = max_batch
@@ -273,6 +379,12 @@ class PagedDecodeServer:
         self.pos = np.zeros((max_batch,), np.int32)
         self.adapter = np.zeros((max_batch,), np.int32)
         self.slots: list[dict | None] = [None] * max_batch
+        # Persistent tick feed: each slot's next input token lives in
+        # row i, updated by .at[i].set at admission and one full-vector
+        # write after each draw — not rebuilt by concatenating
+        # max_batch [1,1] arrays every tick (host dispatch overhead
+        # that dominates at small models). Idle rows are dummies.
+        self._feed = jnp.zeros((max_batch, 1), jnp.int32)
         self._sampler = SlotSampler(max_batch)
         self.pending: list[tuple] = []
         self.done: dict[int, jax.Array] = {}
@@ -465,8 +577,15 @@ class PagedDecodeServer:
         # (e.g. back-to-back bench runs).
         from defer_tpu.utils.memo import cached_step
 
+        builders = {
+            "gathered": self._build_step,
+            "blockwise": self._build_step_blockwise,
+            "pallas": self._build_step_pallas,
+        }
         self._step = cached_step(
-            self.dec, ("paged_step", self.bs), self._build_step
+            self.dec,
+            ("paged_step", self.bs, self.attention),
+            builders[self.attention],
         )
         skip = len(self.shared_blocks)
         self._insert = cached_step(
@@ -518,6 +637,108 @@ class PagedDecodeServer:
                 new_v = vc[rows, :, pos, :]
                 pk_l = pk_l.at[blk, :, row, :].set(new_k)
                 pv_l = pv_l.at[blk, :, row, :].set(new_v)
+                return out, (pk_l, pv_l)
+
+            x, (pk, pv) = lax.scan(
+                body, x, (params["stack"], pk, pv)
+            )
+            logits = dec._final_logits(params, x)
+            return logits, pk, pv
+
+        return jax.jit(step, donate_argnums=(1, 2))
+
+    def _build_step_blockwise(self):
+        """The block-native pure-XLA step: same embed/projection/FFN
+        code as the gathered step (GptDecoder._attn_qkv/_attn_out, so
+        the new K/V rows are bit-identical), but attention folds pool
+        blocks through the block table directly — no contiguous
+        [B, Hkv, MB*bs, Dh] copy is ever materialized, and the fold
+        stops at the deepest live block across the batch. The new row
+        is scattered into the pool BEFORE attention (write-then-attend,
+        like the flat path), through the same (blk, row) indices as
+        the gathered path's scatter-back — idle slots write trash
+        block 0 row 0, the module invariant."""
+        dec, bs = self.dec, self.bs
+        window = dec.cfg.window
+
+        def step(params, pk, pv, tables, pos, ids, adapter_ids):
+            b = ids.shape[0]
+            x = dec._embed_tokens(params, ids, pos)
+            rows = jnp.arange(b)
+            blk_w = tables[rows, pos // bs]  # [B]
+            row_w = pos % bs
+            # Deepest live block over the batch: the fold's traced
+            # bound — reads scale with actual depth, not pool size.
+            nb_live = jnp.max(pos) // bs + 1
+
+            def body(carry, layer):
+                x = carry
+                p, pk_l, pv_l = layer  # [NB, Hkv, bs, Dh]
+                q, k_new, v_new = dec._attn_qkv(
+                    p, x, pos, adapter_ids=adapter_ids
+                )
+                pk_l = pk_l.at[blk_w, :, row_w, :].set(k_new[:, :, 0, :])
+                pv_l = pv_l.at[blk_w, :, row_w, :].set(v_new[:, :, 0, :])
+                attn = _blockwise_attend(
+                    q, pk_l, pv_l, tables, pos, bs, nb_live, window
+                )
+                out = dec._attn_out(
+                    p, x, attn, adapter_ids=adapter_ids
+                )
+                return out, (pk_l, pv_l)
+
+            x, (pk, pv) = lax.scan(
+                body, x, (params["stack"], pk, pv)
+            )
+            logits = dec._final_logits(params, x)
+            return logits, pk, pv
+
+        return jax.jit(step, donate_argnums=(1, 2))
+
+    def _build_step_pallas(self):
+        """The kernel variant of the block-native step: attention goes
+        through ops/pallas_attention.py::paged_flash_decode, whose
+        index maps resolve the block table inside the kernel grid —
+        per slot only its OWN live blocks are DMAed. Compiles to
+        Mosaic on a real TPU; anywhere else the kernel runs through
+        the pallas interpreter (functionally identical, slow — the CI
+        parity test rides the `slow` marker)."""
+        from defer_tpu.models.gpt import _flash_decode_mode
+        from defer_tpu.ops.pallas_attention import paged_flash_decode
+
+        dec, bs = self.dec, self.bs
+        window = dec.cfg.window
+        interpret = _flash_decode_mode() != "tpu"
+
+        def step(params, pk, pv, tables, pos, ids, adapter_ids):
+            b = ids.shape[0]
+            x = dec._embed_tokens(params, ids, pos)
+            rows = jnp.arange(b)
+            blk_w = tables[rows, pos // bs]
+            row_w = pos % bs
+
+            def body(carry, layer):
+                x = carry
+                p, pk_l, pv_l = layer
+                q, k_new, v_new = dec._attn_qkv(
+                    p, x, pos, adapter_ids=adapter_ids
+                )
+                pk_l = pk_l.at[blk_w, :, row_w, :].set(k_new[:, :, 0, :])
+                pv_l = pv_l.at[blk_w, :, row_w, :].set(v_new[:, :, 0, :])
+                b_, hq, _, dh = q.shape
+                attn = paged_flash_decode(
+                    q[:, :, 0, :],
+                    pk_l,
+                    pv_l,
+                    tables,
+                    pos,
+                    window=window,
+                    interpret=interpret,
+                )  # [B, Hq, Dh]
+                attn = attn.astype(x.dtype).reshape(b_, 1, hq * dh)
+                out = dec._attn_out(
+                    p, x, attn, adapter_ids=adapter_ids
+                )
                 return out, (pk_l, pv_l)
 
             x, (pk, pv) = lax.scan(
@@ -736,6 +957,7 @@ class PagedDecodeServer:
             "stop": matcher_or_none(stop_seqs),
         }
         self.slots[i] = slot
+        self._feed = self._feed.at[i].set(first[0].astype(jnp.int32))
         self.obs.ttft.observe(
             time.perf_counter()
             - self._submit_t.pop(rid, time.perf_counter())
@@ -834,6 +1056,9 @@ class PagedDecodeServer:
                 "stop": matcher_or_none(stop_seqs),
             }
             self.slots[i] = slot
+            self._feed = self._feed.at[i].set(
+                first[0].astype(jnp.int32)
+            )
             self.obs.ttft.observe(
                 time.perf_counter()
                 - self._submit_t.pop(rid, time.perf_counter())
@@ -856,17 +1081,13 @@ class PagedDecodeServer:
         if not any(live):
             return
         self._build()
-        feed = jnp.concatenate(
-            [
-                s["last"] if s else jnp.zeros((1, 1), jnp.int32)
-                for s in self.slots
-            ],
-            axis=0,
-        )
+        # Persistent [B,1] device feed (constructor note): admissions
+        # set their row, draws below overwrite the whole vector — no
+        # per-tick concat of max_batch [1,1] arrays.
+        feed = self._feed
         # Idle slots write into trash block 0 at position 0.
-        pos = jnp.asarray(
-            np.where(live, self.pos, 0).astype(np.int32)
-        )
+        posm = np.where(live, self.pos, 0).astype(np.int32)
+        pos = jnp.asarray(posm)
         # COPY the mutable host state before handing it to the device:
         # jnp.asarray of a numpy array is zero-copy on CPU, and the
         # host loop mutates tables/adapter in place (finish/admission)
@@ -888,10 +1109,34 @@ class PagedDecodeServer:
             self.obs.itl.observe(now - self._last_tick_t, n_live)
         self._last_tick_t = now
         self.obs.ticks.inc()
+        # K/V rows the attention path read this tick vs the gathered
+        # baseline (host-side, exact — the counters the bandwidth win
+        # is pinned by; units in obs/serving.py). "blockwise" reads
+        # every slot to the batch's deepest live block; "pallas"
+        # clamps per slot, so each reads only its own live span.
+        baseline = self.B * self.MB * self.bs
+        if self.attention == "gathered":
+            rows_read = baseline
+        elif self.attention == "blockwise":
+            rows_read = (
+                self.B * (int(posm.max()) // self.bs + 1) * self.bs
+            )
+        else:  # pallas
+            win = self.dec.cfg.window
+            lo = (
+                np.maximum(posm - win + 1, 0) // self.bs
+                if win is not None
+                else 0
+            )
+            rows_read = int(np.sum(posm // self.bs - lo + 1)) * self.bs
+        self.obs.kv_rows_read.inc(rows_read)
+        self.obs.kv_rows_gathered.inc(baseline)
+        self.obs.kv_rows_last.set(rows_read)
         if any(s is not None and s["sampling"] for s in self.slots):
             nxt = self._sampler.draw(logits[:, -1, :])
         else:
             nxt = jnp.argmax(logits[:, -1, :], axis=-1)
+        self._feed = nxt[:, None].astype(jnp.int32)
         # Host transfer only when eos/streaming/stop matching needs
         # the values — the plain path stays async (same guard as the
         # flat server).
@@ -960,6 +1205,10 @@ class PagedDecodeServer:
         self.pos[i] = 0
         self.adapter[i] = 0
         self.slots[i] = None
+        # Release the slot's sampling policy row NOW, not at reuse —
+        # a lingering row_sort would drag every later tick through the
+        # sorting sampler (decode_server.SlotSampler.release).
+        self._sampler.release(i)
         self._update_pool_gauges()
 
 
@@ -976,11 +1225,14 @@ def serve_paged(
     prefix_ids: jax.Array | None = None,
     prefix_cache: bool = False,
     sampling: list | None = None,
+    attention: str = "gathered",
 ) -> tuple[list[jax.Array], dict]:
     """One-shot paged serving; returns (outputs in submission order,
     stats incl. peak pool usage). `adapter_ids` optionally assigns a
     LoRA adapter per request (parallel/lora.py::stack_adapters);
-    `sampling` optionally assigns a SamplingParams per request."""
+    `sampling` optionally assigns a SamplingParams per request;
+    `attention` selects the decode attention path
+    (PagedDecodeServer docstring / module docstring)."""
     srv = PagedDecodeServer(
         dec,
         params,
@@ -990,6 +1242,7 @@ def serve_paged(
         eos_id=eos_id,
         prefix_ids=prefix_ids,
         prefix_cache=prefix_cache,
+        attention=attention,
     )
     aids = adapter_ids or [0] * len(requests)
     if len(aids) != len(requests):
@@ -1011,6 +1264,7 @@ def serve_paged(
     stats = ServerStats.snapshot(
         srv.obs.registry,
         ticks=srv.ticks,
+        attention=attention,
         peak_blocks=srv.blocks_peak,
         pool_blocks=int(srv.pool_k.shape[1]) - 1,
         block_size=block_size,

@@ -1,0 +1,204 @@
+#!/usr/bin/env python
+"""Paged-decode attention microbench: tokens/sec and estimated K/V
+bytes read per tick for each attention mode, printed as ONE JSON line.
+
+The point being measured: the gathered path's per-tick HBM traffic is
+O(B * max_blocks * block_size) regardless of request depth, while the
+block-native paths ("blockwise", "pallas") read only live blocks —
+the new obs counters (defer_kv_rows_read_total vs the gathered
+baseline) make the ratio exact, and this bench prices it per mode on
+one identical request mix.
+
+Standalone:
+
+    JAX_PLATFORMS=cpu python scripts/bench_paged.py
+    python scripts/bench_paged.py --modes gathered,blockwise,pallas
+
+Importable: `run_microbench(devices) -> dict` — bench.py runs it as a
+"paged_attention" extras section behind the supervisor/snapshot
+deadline machinery, so a wedged compile cannot sink the headline.
+
+"pallas" is excluded by default off-TPU: the interpret-mode kernel is
+functionally identical but interpreter-slow, which would price the
+mode's dispatch overhead, not its bandwidth. Pass --modes to force it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+_DEFAULT_MODES = ("gathered", "blockwise")
+
+
+def _native_pallas() -> bool:
+    from defer_tpu.ops.attention import _pallas_available
+
+    return _pallas_available()
+
+
+def run_microbench(
+    devices=None,
+    *,
+    modes: tuple = (),
+    num_layers: int = 4,
+    dim: int = 256,
+    num_heads: int = 8,
+    num_kv_heads: int = 4,
+    vocab_size: int = 2048,
+    max_len: int = 512,
+    num_blocks: int = 49,
+    block_size: int = 16,
+    max_batch: int = 4,
+    num_requests: int = 8,
+) -> dict:
+    """Serve one fixed request mix through every attention mode;
+    returns {config, modes: {mode: {tokens_per_sec, kv_rows_read,
+    kv_rows_gathered_baseline, kv_read_ratio, est_kv_bytes_per_tick,
+    ...}}}. Deliberately small defaults: the ratio, not the absolute
+    throughput, is the headline off-TPU."""
+    import jax
+    import jax.numpy as jnp
+
+    from defer_tpu import obs
+    from defer_tpu.models.gpt import GptDecoder
+    from defer_tpu.models.llama import llama_config
+    from defer_tpu.runtime.paged import serve_paged
+
+    if not modes:
+        modes = _DEFAULT_MODES + (
+            ("pallas",) if _native_pallas() else ()
+        )
+    cfg = llama_config(
+        num_layers=num_layers,
+        dim=dim,
+        num_heads=num_heads,
+        num_kv_heads=num_kv_heads,
+        ffn_dim=dim * 2,
+        vocab_size=vocab_size,
+        max_len=max_len,
+    )
+    dec = GptDecoder(cfg, compute_dtype=jnp.bfloat16)
+    params = dec.cast_params(dec.init(jax.random.key(0)))
+    if devices:
+        params = jax.device_put(params, devices[0])
+    reqs = []
+    for i in range(num_requests):
+        t0 = 16 + (i * 23) % 112
+        steps = 16 + (i * 11) % 48
+        prompt = jax.random.randint(
+            jax.random.fold_in(jax.random.key(1), i),
+            (1, t0),
+            0,
+            cfg.vocab_size,
+        )
+        reqs.append((prompt, steps))
+    total_tokens = sum(s for _, s in reqs)
+    dh = cfg.dim // cfg.num_heads
+    # Bytes behind one counted row unit: K+V, every layer, all KV
+    # heads (the counters are layer/head-agnostic; obs/serving.py).
+    bytes_per_row = (
+        2 * cfg.num_layers * cfg.kv_heads * dh
+        * jnp.dtype(dec.compute_dtype).itemsize
+    )
+
+    out: dict = {
+        "config": {
+            "num_layers": num_layers,
+            "dim": dim,
+            "heads": f"{num_heads}/{num_kv_heads}kv",
+            "max_len": max_len,
+            "num_blocks": num_blocks,
+            "block_size": block_size,
+            "max_batch": max_batch,
+            "requests": num_requests,
+            "total_tokens": total_tokens,
+        },
+        "modes": {},
+    }
+    lab = 'server="paged"'
+    for mode in modes:
+        def run():
+            t0 = time.perf_counter()
+            with obs.counter_deltas() as d:
+                outs, stats = serve_paged(
+                    dec,
+                    params,
+                    reqs,
+                    num_blocks=num_blocks,
+                    block_size=block_size,
+                    max_batch=max_batch,
+                    attention=mode,
+                )
+                jax.block_until_ready(outs[-1])
+            return time.perf_counter() - t0, d, stats
+        run()  # compile pass
+        dt, deltas, stats = run()
+        rows = deltas.get(f"defer_kv_rows_read_total{{{lab}}}", 0)
+        base = deltas.get(
+            f"defer_kv_rows_gathered_baseline_total{{{lab}}}", 0
+        )
+        ticks = max(1, stats["ticks"])
+        out["modes"][mode] = {
+            "tokens_per_sec": round(total_tokens / dt, 1),
+            "ticks": stats["ticks"],
+            "kv_rows_read": rows,
+            "kv_rows_gathered_baseline": base,
+            "kv_read_ratio": round(rows / max(1, base), 4),
+            "est_kv_bytes_per_tick": int(
+                rows / ticks * bytes_per_row
+            ),
+            "est_kv_bytes_per_tick_gathered": int(
+                base / ticks * bytes_per_row
+            ),
+        }
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        description="paged-decode attention microbench (one JSON line)"
+    )
+    ap.add_argument(
+        "--modes",
+        default="",
+        help="comma-separated subset of gathered,blockwise,pallas "
+        "(default: gathered,blockwise; +pallas on native TPU)",
+    )
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--dim", type=int, default=256)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--kv-heads", type=int, default=4)
+    ap.add_argument("--vocab", type=int, default=2048)
+    ap.add_argument("--max-len", type=int, default=512)
+    ap.add_argument("--blocks", type=int, default=49)
+    ap.add_argument("--block-size", type=int, default=16)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--requests", type=int, default=8)
+    args = ap.parse_args()
+    modes = tuple(m for m in args.modes.split(",") if m)
+    rec = run_microbench(
+        modes=modes,
+        num_layers=args.layers,
+        dim=args.dim,
+        num_heads=args.heads,
+        num_kv_heads=args.kv_heads,
+        vocab_size=args.vocab,
+        max_len=args.max_len,
+        num_blocks=args.blocks,
+        block_size=args.block_size,
+        max_batch=args.batch,
+        num_requests=args.requests,
+    )
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()

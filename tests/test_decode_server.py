@@ -491,8 +491,20 @@ def test_nosort_dispatch_preserves_solo_parity():
         reqs[0][0], 3,
         sampling=SamplingParams(temperature=1.0, top_k=5, seed=1),
     )
+    # release() clears row_sort the moment a slot finishes, so observe
+    # the flag at release time: it must have been True while the top-k
+    # slot was live, and all-False again once the run drains.
+    sorted_at_release = []
+    orig_release = srv2._sampler.release
+
+    def _spy(i):
+        sorted_at_release.append(srv2._sampler.row_sort[i])
+        orig_release(i)
+
+    srv2._sampler.release = _spy
     done2 = srv2.run()
-    assert any(srv2._sampler.row_sort)
+    assert any(sorted_at_release)
+    assert not any(srv2._sampler.row_sort)
     np.testing.assert_array_equal(
         np.asarray(done2[r_sorted]),
         np.asarray(
