@@ -936,6 +936,27 @@ def run_bench() -> dict:
                 log(f"{key} probe failed ({type(e).__name__}: {e})")
             snapshot(result)
 
+    # Static self-check rides along so the artifact records lint drift
+    # next to the perf numbers (also published on the obs registry as
+    # defer_analysis_findings_total{rule=...}). Sub-second, pure AST.
+    try:
+        from defer_tpu.analysis import analyze_paths
+        from defer_tpu.analysis.runner import record_findings
+
+        pkg = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "defer_tpu"
+        )
+        rep = analyze_paths([pkg], strict=True)
+        record_findings(rep)
+        result["analysis"] = {
+            "findings": len(rep.findings),
+            "suppressed": len(rep.suppressed),
+            "counts": rep.counts,
+        }
+    except Exception as e:  # noqa: BLE001 — extra datapoint only
+        log(f"analysis probe failed ({type(e).__name__}: {e})")
+    snapshot(result)
+
     return result
 
 

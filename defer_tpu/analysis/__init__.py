@@ -1,0 +1,31 @@
+"""defer_tpu.analysis — JAX-aware static lint + runtime trace sanitizer.
+
+The repo's worst regressions were silent host/trace hazards: a host
+concatenate per decode tick, a fresh-closure jit that re-traced every
+call, a full-pool gather hiding inside a correct-looking loop. These
+are mechanical staging bugs (the tracing-DSL literature calls them out
+— TF Eager, arXiv 1903.01855; Julia→TPU, arXiv 1810.09868), so they
+are mechanically detectable.
+
+Two halves:
+
+- Static (AST): ``python -m defer_tpu.analysis --strict defer_tpu/``
+  runs five rules over the package (see rules.py) with a lightweight
+  call-graph walk that scopes host-sync findings to the serving hot
+  paths. Inline escape hatch: ``# analysis: ignore[rule] reason``.
+- Runtime: ``sanitizer.trace_sanitizer(*targets)`` counts XLA
+  lowerings per jitted callable across a block and raises if anything
+  re-traced — the enforcement form of the memo.py discipline.
+"""
+
+from defer_tpu.analysis.runner import AnalysisReport, analyze_paths
+from defer_tpu.analysis.rules import Finding
+from defer_tpu.analysis.sanitizer import RetraceError, trace_sanitizer
+
+__all__ = [
+    "AnalysisReport",
+    "Finding",
+    "RetraceError",
+    "analyze_paths",
+    "trace_sanitizer",
+]

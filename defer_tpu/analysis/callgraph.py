@@ -1,0 +1,182 @@
+"""Lightweight name-based call graph for hot-path scoping.
+
+The host-sync rule must only fire inside code that runs per decode
+tick / per pipeline step — `np.asarray` in a one-shot admission path
+is the sanctioned batched barrier (utils/sync.py), not a regression.
+Precise Python call resolution is undecidable; serving loops don't
+need it. This graph resolves calls *by name*:
+
+- ``self.f(...)`` / ``obj.f(...)`` / ``f(...)`` all link to every
+  analyzed function or method named ``f``.
+
+That open-world rule over-approximates (recall over precision — a
+missed hot function is a missed hazard, a spurious edge at worst asks
+for one justified ignore), and it is robust to the repo's style of
+passing callables around (builders, samplers, sync hooks).
+
+Hot set = everything reachable from the serving roots: ``_tick``
+(both decode servers), ``generate`` / ``speculative_generate`` (model
+decode loops), ``stream`` / ``throughput`` / ``_stream_loop`` (the
+pipeline step loops; ``run_defer`` itself is construction, its loop
+half is the root).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+DEFAULT_ROOTS = (
+    "_tick",
+    "generate",
+    "speculative_generate",
+    "stream",
+    "throughput",
+    "_stream_loop",
+)
+
+# Attribute calls to these names resolve to dict/queue/socket methods
+# far more often than to repo functions; linking them would mark the
+# whole codebase hot through e.g. `input_stream.get()` →
+# `KerasWeights.get`. Bare-name calls still resolve normally.
+_GENERIC_ATTRS = frozenset(
+    "get put set add pop update append extend clear copy close items "
+    "keys values read write flush start run acquire release encode "
+    "decode strip format sort index count insert remove".split()
+)
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    name: str  # bare name ("_tick")
+    qualname: str  # "runtime/paged.py:PagedDecodeServer._tick"
+    path: str
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    # Bare `f(...)` calls are lexically scoped: they resolve within the
+    # same module, plus corpus-wide for names this module from-imports.
+    # `obj.f(...)` attribute calls resolve corpus-wide (methods cross
+    # modules through dispatch), minus _GENERIC_ATTRS.
+    calls_bare: set[str] = dataclasses.field(default_factory=set)
+    calls_attr: set[str] = dataclasses.field(default_factory=set)
+    # Enclosing FUNCTION name chain at the def site, outermost first;
+    # () for module-level functions and class methods. A nested def is
+    # only bare-callable where it is lexically visible, and is never a
+    # valid `obj.name(...)` target — both resolutions use this.
+    scope: tuple[str, ...] = ()
+
+    @property
+    def in_function(self) -> bool:
+        return bool(self.scope)
+
+
+class _Collector(ast.NodeVisitor):
+    def __init__(self, path: str, out: list[FuncInfo]):
+        self.path = path
+        self.out = out
+        self.stack: list[str] = []  # class/function name chain
+        self.kinds: list[str] = []  # "class" | "func", parallel to stack
+
+    def _visit_func(self, node: ast.AST) -> None:
+        qual = ".".join([*self.stack, node.name])
+        info = FuncInfo(
+            name=node.name,
+            qualname=f"{self.path}:{qual}",
+            path=self.path,
+            node=node,
+            scope=tuple(
+                n
+                for n, k in zip(self.stack, self.kinds)
+                if k == "func"
+            ),
+        )
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                f = sub.func
+                if isinstance(f, ast.Name):
+                    info.calls_bare.add(f.id)
+                elif (
+                    isinstance(f, ast.Attribute)
+                    and f.attr not in _GENERIC_ATTRS
+                ):
+                    info.calls_attr.add(f.attr)
+        self.out.append(info)
+        self.stack.append(node.name)
+        self.kinds.append("func")
+        self.generic_visit(node)
+        self.stack.pop()
+        self.kinds.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_func(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._visit_func(node)
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.stack.append(node.name)
+        self.kinds.append("class")
+        self.generic_visit(node)
+        self.stack.pop()
+        self.kinds.pop()
+
+
+class CallGraph:
+    """Functions of the analyzed file set + name-resolved call edges."""
+
+    def __init__(self) -> None:
+        self.functions: list[FuncInfo] = []
+        self.by_name: dict[str, list[FuncInfo]] = {}
+        self.imports: dict[str, set[str]] = {}  # path -> imported names
+
+    def add_module(self, path: str, tree: ast.AST) -> None:
+        found: list[FuncInfo] = []
+        _Collector(path, found).visit(tree)
+        self.functions.extend(found)
+        for fi in found:
+            self.by_name.setdefault(fi.name, []).append(fi)
+        names = self.imports.setdefault(path, set())
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                names.update(a.asname or a.name for a in node.names)
+
+    def _resolve(self, fi: FuncInfo, callee: str, bare: bool):
+        cands = self.by_name.get(callee, [])
+        if bare:
+            chain = (*fi.scope, fi.name)
+            out = [
+                c
+                for c in cands
+                if c.path == fi.path
+                and c.scope == chain[: len(c.scope)]
+            ]
+            if callee in self.imports.get(fi.path, ()):
+                out += [
+                    c
+                    for c in cands
+                    if c.path != fi.path and not c.in_function
+                ]
+            return out
+        return [c for c in cands if not c.in_function]
+
+    def hot_set(self, roots: tuple[str, ...] = DEFAULT_ROOTS) -> set[int]:
+        """ids of FuncInfo.node for every function reachable by name
+        from any root. Nested defs are separate nodes: a closure is hot
+        only if something hot calls it by name."""
+        seen: set[int] = set()
+        frontier = [fi for r in roots for fi in self.by_name.get(r, [])]
+        while frontier:
+            fi = frontier.pop()
+            if id(fi.node) in seen:
+                continue
+            seen.add(id(fi.node))
+            for bare, calls in (
+                (True, fi.calls_bare),
+                (False, fi.calls_attr),
+            ):
+                for callee in calls:
+                    frontier.extend(
+                        c
+                        for c in self._resolve(fi, callee, bare)
+                        if id(c.node) not in seen
+                    )
+        return seen

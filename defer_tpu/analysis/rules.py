@@ -1,0 +1,611 @@
+"""The five static rules. Each is a pure function over the parsed
+corpus; adding a rule = one function + one RULES entry (see
+ARCHITECTURE.md "Static analysis & sanitizers").
+
+Design bias: these guard a serving codebase, so rules prefer recall on
+the hot paths and keep cold paths quiet — `np.asarray` is only a
+finding where it runs per decode tick, a jit-of-closure is only a
+finding where it re-traces per call. Anything intentional gets an
+inline ``# analysis: ignore[rule] reason`` (ignore.py) instead of a
+rule carve-out, so the justification lives next to the hazard.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Callable, Iterator
+
+from defer_tpu.analysis.callgraph import DEFAULT_ROOTS, CallGraph, FuncInfo
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def format(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: "
+            f"{self.rule}: {self.message}"
+        )
+
+
+@dataclasses.dataclass
+class Module:
+    path: str
+    source: str
+    tree: ast.AST
+
+
+@dataclasses.dataclass
+class Context:
+    modules: list[Module]
+    graph: CallGraph
+    roots: tuple[str, ...] = DEFAULT_ROOTS
+
+    def hot(self) -> set[int]:
+        if not hasattr(self, "_hot"):
+            self._hot = self.graph.hot_set(self.roots)
+        return self._hot
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """'jax.random.normal' for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _walk_shallow(node: ast.AST) -> Iterator[ast.AST]:
+    """Document-order walk of one function's own body: nested defs and
+    lambdas are separate analysis units (the call graph decides if
+    *they* are hot), so their bodies are not yielded here."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, _FUNC_NODES):
+            continue
+        yield child
+        yield from _walk_shallow(child)
+
+
+def _root_name(node: ast.AST) -> str | None:
+    """Base Name of a Name/Subscript chain: `host[i]` -> 'host'."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+# -- host-sync-in-hot-loop --------------------------------------------
+
+_NP_MODULES = {"np", "numpy", "onp"}
+_SYNC_ATTRS = {"item", "block_until_ready"}
+
+
+def _host_transfer_call(call: ast.Call) -> str | None:
+    """Name the host transfer if this call is one, else None."""
+    f = call.func
+    dotted = _dotted(f)
+    if dotted in ("jax.device_get", "device_get"):
+        return dotted
+    if (
+        isinstance(f, ast.Attribute)
+        and isinstance(f.value, ast.Name)
+        and f.value.id in _NP_MODULES
+        and f.attr in ("asarray", "array")
+    ):
+        return f"{f.value.id}.{f.attr}"
+    return None
+
+
+def _host_exprs(value: ast.AST) -> Iterator[ast.AST]:
+    """Unwrap conditional assigns: `np.asarray(x) if c else None`."""
+    if isinstance(value, ast.IfExp):
+        yield from _host_exprs(value.body)
+        yield from _host_exprs(value.orelse)
+    else:
+        yield value
+
+
+def rule_host_sync(ctx: Context) -> list[Finding]:
+    out: list[Finding] = []
+    hot = ctx.hot()
+    for fi in ctx.graph.functions:
+        if id(fi.node) not in hot:
+            continue
+        # Names assigned from an (already flagged) host transfer are
+        # host data: `int(host_nxt[i])` after `host_nxt = np.asarray(..)`
+        # costs nothing extra and is not re-flagged.
+        host_names: set[str] = set()
+        for node in _walk_shallow(fi.node):
+            if isinstance(node, ast.Assign):
+                for v in _host_exprs(node.value):
+                    if isinstance(v, ast.Call) and _host_transfer_call(v):
+                        for tgt in node.targets:
+                            name = _root_name(tgt)
+                            if name:
+                                host_names.add(name)
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            what = _host_transfer_call(node)
+            if what is None and isinstance(f, ast.Attribute):
+                if f.attr in _SYNC_ATTRS:
+                    what = f".{f.attr}()"
+            if what is None and isinstance(f, ast.Name):
+                # int(arr[i]) / float(arr[i]): per-element device
+                # indexing, one sync each. Plain int(x) is too often a
+                # python scalar to judge statically, so only the
+                # subscript form is flagged.
+                if f.id in ("int", "float") and len(node.args) == 1:
+                    arg = node.args[0]
+                    name = _root_name(arg)
+                    if (
+                        isinstance(arg, ast.Subscript)
+                        and name is not None
+                        and name not in host_names
+                    ):
+                        what = f"{f.id}() on a subscripted device value"
+            if what is not None:
+                out.append(
+                    Finding(
+                        "host-sync-in-hot-loop",
+                        fi.path,
+                        node.lineno,
+                        node.col_offset,
+                        f"{what} in `{fi.qualname.split(':', 1)[1]}`, "
+                        f"which is reachable from serving roots "
+                        f"{ctx.roots} — a device sync per tick/step; "
+                        "batch it behind the Retirer or justify with "
+                        "an ignore",
+                    )
+                )
+    return out
+
+
+# -- fresh-closure-jit ------------------------------------------------
+
+
+def _is_jit(call: ast.Call) -> bool:
+    return _dotted(call.func) in ("jax.jit", "jit")
+
+
+class _JitVisitor(ast.NodeVisitor):
+    def __init__(self, mod: Module, hot: set[int], out: list[Finding]):
+        self.mod = mod
+        self.hot = hot
+        self.out = out
+        self.loop_depth = 0
+        # Enclosing functions, innermost last; each entry carries the
+        # names of defs nested inside it (fresh per call) and the ids
+        # of jit calls whose result the function RETURNS — the builder
+        # pattern, where a caller (cached_step/jit_cached) memoizes.
+        self.func_stack: list[tuple[ast.AST, set[str], set[int]]] = []
+
+    def _local_def_names(self, node: ast.AST) -> set[str]:
+        names = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if sub is not node:
+                    names.add(sub.name)
+        return names
+
+    def _returned_calls(self, node: ast.AST) -> set[int]:
+        out: set[int] = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Return) and sub.value is not None:
+                out.update(
+                    id(c)
+                    for c in ast.walk(sub.value)
+                    if isinstance(c, ast.Call)
+                )
+        return out
+
+    def visit_For(self, node: ast.For) -> None:
+        self._loop(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._loop(node)
+
+    def _loop(self, node: ast.AST) -> None:
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._func(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._func(node)
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._func(node)
+
+    def _func(self, node: ast.AST) -> None:
+        # A loop wrapping the *definition* does not wrap the body.
+        saved = self.loop_depth
+        self.loop_depth = 0
+        self.func_stack.append(
+            (node, self._local_def_names(node), self._returned_calls(node))
+        )
+        self.generic_visit(node)
+        self.func_stack.pop()
+        self.loop_depth = saved
+
+    def _fresh_closure(self, arg: ast.AST) -> bool:
+        if isinstance(arg, ast.Lambda):
+            return True
+        if isinstance(arg, ast.Name) and self.func_stack:
+            return arg.id in self.func_stack[-1][1]
+        return False
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if _is_jit(node) and node.args:
+            arg = node.args[0]
+            in_func = bool(self.func_stack)
+            in_hot = in_func and id(self.func_stack[-1][0]) in self.hot
+            # `return jax.jit(fn)` hands the callable to the caller for
+            # memoization (the cached_step builder idiom) — only flag
+            # that when it sits inside a loop.
+            returned = in_func and id(node) in self.func_stack[-1][2]
+            fresh = self._fresh_closure(arg)
+            if fresh and (
+                self.loop_depth > 0 or (in_hot and not returned)
+            ):
+                where = (
+                    "inside a loop" if self.loop_depth else "on a hot path"
+                )
+                self.out.append(
+                    Finding(
+                        "fresh-closure-jit",
+                        self.mod.path,
+                        node.lineno,
+                        node.col_offset,
+                        "jax.jit of a closure created per iteration/call "
+                        f"{where}: jit's cache is keyed on the function "
+                        "OBJECT, so this re-traces every time — memoize "
+                        "via utils/memo.cached_step or memo.jit_cached",
+                    )
+                )
+        # jax.jit(f)(x): the jitted callable is dropped immediately, so
+        # its cache dies with it — every call re-traces. This form is a
+        # finding regardless of what f is.
+        if (
+            isinstance(node.func, ast.Call)
+            and _is_jit(node.func)
+        ):
+            self.out.append(
+                Finding(
+                    "fresh-closure-jit",
+                    self.mod.path,
+                    node.lineno,
+                    node.col_offset,
+                    "jax.jit(f)(...) discards the jitted callable after "
+                    "one call, so its compile cache can never hit — bind "
+                    "it once (module level or memo.jit_cached)",
+                )
+            )
+        self.generic_visit(node)
+
+
+def rule_fresh_closure_jit(ctx: Context) -> list[Finding]:
+    out: list[Finding] = []
+    hot = ctx.hot()
+    for mod in ctx.modules:
+        _JitVisitor(mod, hot, out).visit(mod.tree)
+    return out
+
+
+# -- prng-key-reuse ---------------------------------------------------
+
+_KEY_PRODUCERS = {"key", "PRNGKey", "split", "fold_in", "clone"}
+_KEY_NEUTRAL = _KEY_PRODUCERS | {"wrap_key_data", "key_data", "key_impl"}
+
+
+def _random_attr(call: ast.Call) -> str | None:
+    """'normal' for jax.random.normal(...) / random.normal(...)."""
+    dotted = _dotted(call.func)
+    if dotted is None:
+        return None
+    parts = dotted.split(".")
+    if len(parts) >= 2 and parts[-2] == "random":
+        return parts[-1]
+    return None
+
+
+def _key_id(node: ast.AST) -> object | None:
+    """Track plain names and constant-indexed subscripts: `ks[3]` and
+    `ks[4]` are distinct keys; `ks[i]` is untrackable (None)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Subscript) and isinstance(
+        node.value, ast.Name
+    ):
+        idx = node.slice
+        if isinstance(idx, ast.Constant):
+            return (node.value.id, idx.value)
+    return None
+
+
+def _expr_calls(stmt: ast.AST) -> Iterator[ast.Call]:
+    """Call nodes of one statement's expressions, document order,
+    not descending into nested function/lambda bodies or into the
+    bodies of compound statements (handled by _prng_block)."""
+    skip = (*_FUNC_NODES, ast.If, ast.For, ast.While, ast.With, ast.Try)
+
+    def rec(node: ast.AST) -> Iterator[ast.Call]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, skip) or isinstance(child, ast.stmt):
+                continue
+            if isinstance(child, ast.Call):
+                yield child
+            yield from rec(child)
+
+    if isinstance(stmt, ast.Call):
+        yield stmt
+    yield from rec(stmt)
+
+
+def _prng_stmt(
+    stmt: ast.AST,
+    draws: dict[object, int],
+    out: list[Finding],
+    path: str,
+) -> None:
+    for call in _expr_calls(stmt):
+        attr = _random_attr(call)
+        if attr is None or attr in _KEY_NEUTRAL:
+            continue
+        key_arg = call.args[0] if call.args else None
+        for kw in call.keywords:
+            if kw.arg == "key":
+                key_arg = kw.value
+        kid = _key_id(key_arg) if key_arg is not None else None
+        if kid is None:
+            continue
+        draws[kid] = draws.get(kid, 0) + 1
+        if draws[kid] == 2:
+            name = kid if isinstance(kid, str) else (
+                f"{kid[0]}[{kid[1]!r}]"
+            )
+            out.append(
+                Finding(
+                    "prng-key-reuse",
+                    path,
+                    call.lineno,
+                    call.col_offset,
+                    f"PRNG key `{name}` feeds a second "
+                    f"jax.random.{attr} draw with no intervening "
+                    "split — the two draws are perfectly "
+                    "correlated; jax.random.split first",
+                )
+            )
+    if isinstance(stmt, ast.Assign):
+        # Any rebind of a name makes it a fresh key (or not a key at
+        # all) — reset its draw count.
+        for tgt in stmt.targets:
+            elts = (
+                tgt.elts
+                if isinstance(tgt, (ast.Tuple, ast.List))
+                else [tgt]
+            )
+            for e in elts:
+                kid = _key_id(e)
+                if kid is not None:
+                    draws[kid] = 0
+
+
+def _terminates(stmts: list[ast.stmt]) -> bool:
+    """A branch ending in return/raise/break/continue never reaches
+    the statements after the `if` — its draw state must not merge
+    into the fall-through path."""
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Raise, ast.Break, ast.Continue)
+    )
+
+
+def _prng_block(
+    stmts: list[ast.stmt],
+    draws: dict[object, int],
+    out: list[Finding],
+    path: str,
+) -> None:
+    """Statement interpreter with branch awareness: exclusive `if`
+    arms each start from the pre-branch state and merge by max, so one
+    draw per arm is not 'two draws'. Loop bodies run once (a single
+    textual draw repeated by iteration is a known miss)."""
+    for stmt in stmts:
+        if isinstance(stmt, (*_FUNC_NODES, ast.ClassDef)):
+            continue  # separate analysis units
+        if isinstance(stmt, ast.If):
+            _prng_stmt(stmt.test, draws, out, path)
+            d_then, d_else = dict(draws), dict(draws)
+            _prng_block(stmt.body, d_then, out, path)
+            _prng_block(stmt.orelse, d_else, out, path)
+            live = [
+                d for d, body in ((d_then, stmt.body), (d_else, stmt.orelse))
+                if not _terminates(body)
+            ] or [d_then, d_else]
+            for k in set().union(*live):
+                draws[k] = max(d.get(k, 0) for d in live)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            _prng_stmt(stmt.iter, draws, out, path)
+            _prng_block(stmt.body, draws, out, path)
+            _prng_block(stmt.orelse, draws, out, path)
+        elif isinstance(stmt, ast.While):
+            _prng_stmt(stmt.test, draws, out, path)
+            _prng_block(stmt.body, draws, out, path)
+            _prng_block(stmt.orelse, draws, out, path)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                _prng_stmt(item.context_expr, draws, out, path)
+            _prng_block(stmt.body, draws, out, path)
+        elif isinstance(stmt, ast.Try):
+            _prng_block(stmt.body, draws, out, path)
+            for h in stmt.handlers:
+                _prng_block(h.body, draws, out, path)
+            _prng_block(stmt.orelse, draws, out, path)
+            _prng_block(stmt.finalbody, draws, out, path)
+        else:
+            _prng_stmt(stmt, draws, out, path)
+
+
+def rule_prng_key_reuse(ctx: Context) -> list[Finding]:
+    out: list[Finding] = []
+    for fi in ctx.graph.functions:
+        body = getattr(fi.node, "body", [])
+        if isinstance(body, list):
+            _prng_block(body, {}, out, fi.path)
+    return out
+
+
+# -- lock-discipline --------------------------------------------------
+
+_BLOCKING = {
+    "join",
+    "accept",
+    "recv",
+    "recv_into",
+    "recvfrom",
+    "sendall",
+    "connect",
+    "create_connection",
+    "predict",
+    "sleep",
+}
+
+
+def _mentions_lock(node: ast.AST) -> bool:
+    if isinstance(node, ast.Name):
+        return "lock" in node.id.lower()
+    if isinstance(node, ast.Attribute):
+        return "lock" in node.attr.lower()
+    if isinstance(node, ast.Call):  # e.g. `with lock_for(x):`
+        return _mentions_lock(node.func)
+    return False
+
+
+def rule_lock_discipline(ctx: Context) -> list[Finding]:
+    out: list[Finding] = []
+    for mod in ctx.modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            if not any(
+                _mentions_lock(item.context_expr) for item in node.items
+            ):
+                continue
+            for stmt in node.body:
+                for sub in [stmt, *_walk_shallow(stmt)]:
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    f = sub.func
+                    name = (
+                        f.attr
+                        if isinstance(f, ast.Attribute)
+                        else f.id
+                        if isinstance(f, ast.Name)
+                        else None
+                    )
+                    if name in _BLOCKING:
+                        out.append(
+                            Finding(
+                                "lock-discipline",
+                                mod.path,
+                                sub.lineno,
+                                sub.col_offset,
+                                f"blocking call .{name}() while holding "
+                                "a lock — every other thread touching "
+                                "this lock stalls behind the I/O; move "
+                                "the wait outside the critical section",
+                            )
+                        )
+    return out
+
+
+# -- obs-name-drift ---------------------------------------------------
+
+_OBS_KINDS = {"counter", "gauge", "histogram"}
+_NAME_RE = re.compile(r"^defer_[a-z0-9_]+$")
+
+
+def rule_obs_name_drift(ctx: Context) -> list[Finding]:
+    out: list[Finding] = []
+    first_kind: dict[str, tuple[str, str, int]] = {}  # name -> kind,at
+    for mod in ctx.modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (
+                isinstance(f, ast.Attribute) and f.attr in _OBS_KINDS
+            ):
+                continue
+            if not node.args or not (
+                isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                continue  # dynamic names can't be checked statically
+            name = node.args[0].value
+            kind = f.attr
+            loc = (mod.path, node.lineno, node.col_offset)
+            if not _NAME_RE.match(name):
+                out.append(
+                    Finding(
+                        "obs-name-drift",
+                        *loc,
+                        f"metric name {name!r} breaks the registry "
+                        "convention ^defer_[a-z0-9_]+$ — dashboards "
+                        "key on the defer_ prefix",
+                    )
+                )
+            elif kind == "counter" and not name.endswith("_total"):
+                out.append(
+                    Finding(
+                        "obs-name-drift",
+                        *loc,
+                        f"counter {name!r} must end in _total "
+                        "(Prometheus counter convention)",
+                    )
+                )
+            elif kind != "counter" and name.endswith("_total"):
+                out.append(
+                    Finding(
+                        "obs-name-drift",
+                        *loc,
+                        f"{kind} {name!r} ends in _total, which marks "
+                        "counters — rename or change the instrument",
+                    )
+                )
+            seen = first_kind.setdefault(name, (kind, mod.path, node.lineno))
+            if seen[0] != kind:
+                out.append(
+                    Finding(
+                        "obs-name-drift",
+                        *loc,
+                        f"{name!r} registered as a {kind} here but as "
+                        f"a {seen[0]} at {seen[1]}:{seen[2]} — one "
+                        "name, one instrument kind",
+                    )
+                )
+    return out
+
+
+RULES: dict[str, Callable[[Context], list[Finding]]] = {
+    "host-sync-in-hot-loop": rule_host_sync,
+    "fresh-closure-jit": rule_fresh_closure_jit,
+    "prng-key-reuse": rule_prng_key_reuse,
+    "lock-discipline": rule_lock_discipline,
+    "obs-name-drift": rule_obs_name_drift,
+}

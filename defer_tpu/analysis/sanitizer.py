@@ -1,0 +1,123 @@
+"""Runtime half: prove a block of code does not re-trace.
+
+The static rules catch the *patterns* that retrace; this catches the
+fact. ``trace_sanitizer(...)`` snapshots the compile-cache size of
+every jitted callable it can find in its targets, runs the block, and
+raises ``RetraceError`` if anything lowered again — the enforcement
+form of PR 3's "warm up, then the tick loop must be trace-stable"
+discipline.
+
+    srv = PagedDecodeServer(...)
+    ...admit + warmup ticks...
+    with trace_sanitizer(srv, defer_tpu.models.gpt) as rep:
+        for _ in range(3):
+            srv._tick()
+    # raises if any step/sampler callable compiled a new variant
+
+Targets may be:
+- a jitted callable (anything exposing ``_cache_size()``, which
+  jax.jit wrappers do on every jax this repo supports),
+- a module (its jitted globals are scanned),
+- any object (its attributes are scanned, one level of dict attrs
+  included — which picks up the ``_step_cache`` dict that
+  utils/memo.cached_step hangs on decoder instances).
+
+Targets are scanned at ``__enter__``: a callable jitted *inside* the
+block is by definition a fresh trace and should instead be built in
+warmup. Counting uses per-callable cache-size deltas rather than
+``jax.monitoring`` events, which fire at varying multiplicity per
+compile across jax versions — cache growth is exact.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import types
+from typing import Any, Iterator
+
+
+class RetraceError(AssertionError):
+    """A jitted callable compiled a new variant inside a sanitized
+    block. Subclasses AssertionError so pytest reports it as a plain
+    test failure, not an error."""
+
+
+def _cache_size(fn: Any) -> int | None:
+    try:
+        return int(fn._cache_size())
+    except Exception:  # noqa: BLE001 — not a countable jitted callable
+        return None
+
+
+def _is_jitted(obj: Any) -> bool:
+    return callable(obj) and _cache_size(obj) is not None
+
+
+def _scan(targets: tuple[Any, ...]) -> dict[str, Any]:
+    found: dict[str, Any] = {}
+
+    def add(label: str, fn: Any) -> None:
+        if not any(fn is g for g in found.values()):
+            found.setdefault(label, fn)
+
+    for t in targets:
+        if _is_jitted(t):
+            add(getattr(t, "__name__", repr(t)), t)
+        elif isinstance(t, types.ModuleType):
+            for k, v in vars(t).items():
+                if _is_jitted(v):
+                    add(f"{t.__name__}.{k}", v)
+        else:
+            tname = type(t).__name__
+            for k, v in list(getattr(t, "__dict__", {}).items()):
+                if _is_jitted(v):
+                    add(f"{tname}.{k}", v)
+                elif isinstance(v, dict):
+                    for kk, vv in v.items():
+                        if _is_jitted(vv):
+                            add(f"{tname}.{k}[{kk!r}]", vv)
+    return found
+
+
+class TraceReport:
+    """Filled in at block exit: what was watched, what re-traced."""
+
+    def __init__(self) -> None:
+        self.watched: list[str] = []
+        self.deltas: dict[str, int] = {}
+
+    @property
+    def retraces(self) -> int:
+        return sum(self.deltas.values())
+
+
+@contextlib.contextmanager
+def trace_sanitizer(*targets: Any, allow: int = 0) -> Iterator[TraceReport]:
+    """Fail the block if watched jitted callables trace > `allow` new
+    variants in total. Raises ValueError when no jitted callable is
+    found in `targets` — a sanitizer watching nothing proves nothing."""
+    fns = _scan(targets)
+    if not fns:
+        raise ValueError(
+            "trace_sanitizer found no jitted callables in its targets "
+            "— pass jitted functions, modules, or warmed-up objects"
+        )
+    report = TraceReport()
+    report.watched = list(fns)
+    before = {label: _cache_size(fn) for label, fn in fns.items()}
+    try:
+        yield report
+    finally:
+        for label, fn in fns.items():
+            after = _cache_size(fn)
+            if after is not None and after > before[label]:
+                report.deltas[label] = after - before[label]
+    if report.retraces > allow:
+        detail = ", ".join(
+            f"{label}: +{n}" for label, n in sorted(report.deltas.items())
+        )
+        raise RetraceError(
+            f"{report.retraces} retrace(s) inside sanitized block "
+            f"(allow={allow}): {detail} — a warmed hot loop must be "
+            "trace-stable; see utils/memo.py"
+        )

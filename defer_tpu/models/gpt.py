@@ -362,6 +362,10 @@ def sampled_decode_loop(
                     jnp.asarray(pad_tok, dtype),
                     nxt,
                 )
+            # analysis: ignore[host-sync-in-hot-loop] stop matching is
+            # a host automaton: one batched [B] transfer per step is
+            # the documented price of stop_sequences (this branch only
+            # runs when they are set)
             host_nxt = np.asarray(nxt[:, 0])
             # The per-token host sync is already paid here, so the eos
             # mask is free every step — it guards the matchers (an
@@ -369,6 +373,8 @@ def sampled_decode_loop(
             # matching covers GENERATED tokens only) and breaks the
             # loop without waiting for the EOS_POLL_EVERY cadence.
             eos_done = (
+                # analysis: ignore[host-sync-in-hot-loop] rides the
+                # per-token sync already paid just above — see comment
                 np.asarray(finished) if eos_id is not None else None
             )
             for r in range(b):
@@ -930,6 +936,8 @@ class GptDecoder:
                 "prefill needs a scalar-position cache (per-slot "
                 "caches admit through runtime/decode_server.py)"
             )
+        # analysis: ignore[host-sync-in-hot-loop] one scalar sync per
+        # prefill (admission time, not per tick) to guard overflow
         base = int(jax.device_get(cache["pos"]))
         if self.rolling_cache:
             # Rolling caches have no end to overflow — positions are

@@ -529,6 +529,8 @@ class T5:
         head, and `lax.dynamic_update_slice` CLAMPS an out-of-range
         start — an unguarded overflow would silently overwrite live
         cache rows (same hazard gpt.py's prefill guards)."""
+        # analysis: ignore[host-sync-in-hot-loop] one scalar sync per
+        # prefill (admission time, not per tick) to guard overflow
         base = int(jax.device_get(cache["pos"]))
         t = ids.shape[1]
         if base + t > self.cfg.max_len:

@@ -140,7 +140,12 @@ class Pipeline(StreamMeasure):
             # already on the device aliases it) — never donate that.
             # Later stages consume pipeline-owned transfer buffers.
             donate = (1,) if self.config.donate_activations and i > 0 else ()
+            # analysis: ignore[fresh-closure-jit] one jit per STAGE at
+            # construction, held in stage_fns for the pipeline's
+            # lifetime — never rebuilt per call
             self.stage_fns.append(jax.jit(stage_apply, donate_argnums=donate))
+            # analysis: ignore[fresh-closure-jit] same: built once,
+            # cached on the instance
             self._plain_fns.append(jax.jit(stage_apply))
         # One shared counter across every Pipeline (incl. the ones a
         # ReplicatedPipeline builds per replica): total microbatches

@@ -497,6 +497,9 @@ class DecodeServer:
                 for s in self.slots
             )
         )
+        # analysis: ignore[host-sync-in-hot-loop] single batched [B,1]
+        # transfer, and only when an eos/stop/stream consumer needs
+        # host tokens — the sync this serving loop is designed around
         host_nxt = np.asarray(nxt) if need_host else None
         for i, slot in enumerate(self.slots):
             if slot.req is None:

@@ -151,6 +151,9 @@ class ArraySender:
             )
             frame = codec.encode(a, level=level)
         with self._lock:
+            # analysis: ignore[lock-discipline] serializing whole
+            # frames onto one socket is this lock's entire job;
+            # concurrent senders must queue behind the write
             self._sock.sendall(_HEADER.pack(_TAG_ARRAY, len(frame)) + frame)
         _obs_tx_frames.inc()
         _obs_tx_bytes.inc(_HEADER.size + len(frame))
@@ -160,6 +163,8 @@ class ArraySender:
         lacks) and close."""
         try:
             with self._lock:
+                # analysis: ignore[lock-discipline] the STOP frame must
+                # not interleave mid-frame with a concurrent send
                 self._sock.sendall(_HEADER.pack(_TAG_STOP, 0))
             self._sock.close()
         except OSError:

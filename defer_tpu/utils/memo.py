@@ -10,6 +10,8 @@ from __future__ import annotations
 
 from typing import Any, Callable
 
+import jax
+
 
 def cached_step(obj: Any, key: Any, build: Callable[[], Any]) -> Any:
     """Build-once per (instance, key); subsequent calls return the same
@@ -20,3 +22,32 @@ def cached_step(obj: Any, key: Any, build: Callable[[], Any]) -> Any:
     if key not in cache:
         cache[key] = build()
     return cache[key]
+
+
+_JIT_CACHE: dict[Any, Any] = {}
+
+
+def jit_cached(
+    fn: Callable[..., Any], static_key: Any, **jit_kwargs: Any
+) -> Any:
+    """Process-wide keyed jit cache for closures with no instance to
+    hang a `_step_cache` on.
+
+    `jax.jit(fresh_closure)` in a per-call function re-traces every
+    call; this returns one jitted callable per (static_key, jit
+    options) forever after. Contract: `static_key` must fully
+    determine the closure's behavior — the FIRST closure built for a
+    key wins, and later semantically-different closures under the same
+    key would silently run the first one's trace. Key on everything
+    the closure captures (model name, dtype, flags), exactly like
+    static_argnums for captured state.
+
+    Entries are never evicted (the cache holds whatever the closure
+    captures alive), so keys must come from a bounded set — config
+    values, not per-request data.
+    """
+    key = (static_key, tuple(sorted(jit_kwargs.items())))
+    got = _JIT_CACHE.get(key)
+    if got is None:
+        got = _JIT_CACHE[key] = jax.jit(fn, **jit_kwargs)
+    return got

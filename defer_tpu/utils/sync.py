@@ -31,8 +31,13 @@ def hard_sync(*arrays: Any) -> None:
     pytrees — multi-tensor pipeline boundaries pass activation tuples."""
     for arr in jax.tree_util.tree_leaves(arrays):
         if getattr(arr, "ndim", 0) > 0 and arr.size > 1:
+            # analysis: ignore[host-sync-in-hot-loop] this IS the
+            # sanctioned barrier primitive — hot paths amortize it
+            # through Retirer windows (one fetch per window)
             np.asarray(arr.ravel()[-1:])
         else:
+            # analysis: ignore[host-sync-in-hot-loop] same: the
+            # barrier primitive itself, scalar case
             np.asarray(arr)
 
 

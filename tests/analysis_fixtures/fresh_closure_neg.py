@@ -1,0 +1,23 @@
+"""NEGATIVE: the sanctioned shapes — module-level jit, and a builder
+that RETURNS the jitted callable to a memoizing caller (the
+utils/memo.cached_step idiom)."""
+
+import jax
+
+
+@jax.jit
+def double(x):
+    return x * 2
+
+
+def build_step(dec):
+    def step(p, x):
+        return dec.apply(p, x)
+
+    return jax.jit(step)  # caller memoizes; traced once per decoder
+
+
+class Decoder:
+    def generate(self, params, ids):
+        step = self._cache.setdefault("step", build_step(self))
+        return step(params, ids)

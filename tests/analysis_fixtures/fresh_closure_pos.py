@@ -1,0 +1,20 @@
+"""POSITIVE: jit of a closure created per loop iteration, and the
+throwaway jit-then-call form — both defeat jit's function-object
+cache."""
+
+import jax
+
+
+def build_stages(stages):
+    fns = []
+    for stage in stages:
+
+        def apply(p, x, _s=stage):
+            return _s(p, x)
+
+        fns.append(jax.jit(apply))  # fresh closure every iteration
+    return fns
+
+
+def run_once(f, x):
+    return jax.jit(f)(x)  # callable dropped after one call

@@ -1,0 +1,14 @@
+"""POSITIVE: host syncs inside the serving hot set — one directly in
+the `_tick` root, one in a helper reachable from it."""
+
+import numpy as np
+
+
+class Server:
+    def _tick(self):
+        nxt = self._advance()
+        toks = np.asarray(nxt)  # per-tick device->host transfer
+        self._emit(toks)
+
+    def _emit(self, toks):
+        self.out.append(toks.item())  # reachable from _tick

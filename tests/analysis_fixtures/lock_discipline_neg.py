@@ -1,0 +1,14 @@
+"""NEGATIVE: the lock guards only shared state; blocking calls happen
+outside the critical section."""
+
+
+class Sender:
+    def send(self, frame):
+        with self._lock:
+            self._queue.append(frame)
+        self._sock.sendall(frame)
+
+    def stop(self):
+        with self._lock:
+            self._closing = True
+        self._worker.join()

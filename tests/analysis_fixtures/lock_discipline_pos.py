@@ -1,0 +1,12 @@
+"""POSITIVE: blocking I/O and a thread join while holding a lock —
+every other thread touching the lock stalls behind the wait."""
+
+
+class Sender:
+    def send(self, frame):
+        with self._lock:
+            self._sock.sendall(frame)  # I/O inside the critical section
+
+    def stop(self):
+        with self._lock:
+            self._worker.join()  # unbounded wait under the lock

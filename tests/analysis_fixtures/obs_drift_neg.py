@@ -1,0 +1,9 @@
+"""NEGATIVE: conforming names — defer_ prefix, counters end _total,
+one instrument kind per name."""
+
+from defer_tpu.obs.metrics import get_registry
+
+reg = get_registry()
+ticks = reg.counter("defer_serving_ticks_total", "Ticks run")
+depth = reg.gauge("defer_queue_depth", "Pending requests")
+lat = reg.histogram("defer_tick_seconds", "Tick latency")

@@ -1,0 +1,9 @@
+"""POSITIVE: three convention breaks — missing defer_ prefix, counter
+without _total, non-counter ending in _total."""
+
+from defer_tpu.obs.metrics import get_registry
+
+reg = get_registry()
+ticks = reg.counter("serving_ticks_total", "Ticks run")
+tx = reg.counter("defer_tx_bytes", "Bytes sent")
+depth = reg.gauge("defer_queue_depth_total", "Pending requests")

@@ -1,0 +1,24 @@
+"""NEGATIVE: split before the second draw; exclusive branch arms each
+draw once; a rebind makes a name a fresh key."""
+
+import jax
+
+
+def sample_pair(key):
+    ka, kb = jax.random.split(key)
+    a = jax.random.normal(ka, (4,))
+    b = jax.random.uniform(kb, (4,))
+    return a, b
+
+
+def sample_branch(key, greedy):
+    if greedy:
+        return jax.random.normal(key, (4,))
+    return jax.random.uniform(key, (4,))
+
+
+def sample_chain(key):
+    x = jax.random.normal(key, (4,))
+    key = jax.random.fold_in(key, 1)
+    y = jax.random.normal(key, (4,))
+    return x, y

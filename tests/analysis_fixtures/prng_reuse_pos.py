@@ -1,0 +1,10 @@
+"""POSITIVE: one key feeds two draws with no intervening split — the
+draws are perfectly correlated."""
+
+import jax
+
+
+def sample_pair(key):
+    a = jax.random.normal(key, (4,))
+    b = jax.random.uniform(key, (4,))  # same key, second draw
+    return a, b

@@ -37,6 +37,17 @@ def devices():
     return devs
 
 
+@pytest.fixture
+def trace_sanitizer():
+    """The analysis subsystem's no-retrace guard
+    (defer_tpu/analysis/sanitizer.py): wrap a warmed hot loop and the
+    test fails with RetraceError if any watched jitted callable
+    compiles a new variant inside the block."""
+    from defer_tpu.analysis.sanitizer import trace_sanitizer as ts
+
+    return ts
+
+
 FLAKY = {"failures": 0}
 
 

@@ -1,0 +1,260 @@
+"""defer_tpu.analysis: static rules against the fixture corpus, the
+strict pass over the shipped tree (tier-1 enforcement), and the
+runtime trace sanitizer — including the paged server's post-warmup
+trace stability."""
+
+import json
+import pathlib
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from defer_tpu.analysis import (
+    RetraceError,
+    analyze_paths,
+    trace_sanitizer as sanitize,
+)
+from defer_tpu.analysis.runner import main, record_findings
+from defer_tpu.obs.metrics import MetricsRegistry
+
+HERE = pathlib.Path(__file__).resolve().parent
+FIXTURES = HERE / "analysis_fixtures"
+REPO = HERE.parent
+
+# (rule, fixture stem, expected positive-finding count) — keep in sync
+# with tests/analysis_fixtures/ (see its README).
+CASES = [
+    ("host-sync-in-hot-loop", "host_sync", 2),
+    ("fresh-closure-jit", "fresh_closure", 2),
+    ("prng-key-reuse", "prng_reuse", 1),
+    ("lock-discipline", "lock_discipline", 2),
+    ("obs-name-drift", "obs_drift", 3),
+]
+
+
+def _run(path, rule):
+    return analyze_paths([str(path)], rules=[rule])
+
+
+# -- static rules over the fixture corpus ------------------------------
+
+
+@pytest.mark.parametrize("rule,stem,n", CASES)
+def test_rule_catches_positive_fixture(rule, stem, n):
+    rep = _run(FIXTURES / f"{stem}_pos.py", rule)
+    assert len(rep.findings) == n, [f.format() for f in rep.findings]
+    assert all(f.rule == rule for f in rep.findings)
+
+
+@pytest.mark.parametrize("rule,stem,n", CASES)
+def test_rule_passes_negative_fixture(rule, stem, n):
+    rep = _run(FIXTURES / f"{stem}_neg.py", rule)
+    assert rep.findings == [], [f.format() for f in rep.findings]
+
+
+def test_shipped_tree_is_strict_clean():
+    """The tier-1 gate: every rule over defer_tpu/ is clean or carries
+    a justified ignore. A failure here means a new hazard landed
+    without a reason next to it."""
+    rep = analyze_paths([str(REPO / "defer_tpu")], strict=True)
+    assert rep.findings == [], "\n".join(f.format() for f in rep.findings)
+    # The 20 deliberate sites (hard_sync itself, the serving syncs,
+    # per-stage construction jits, framing locks) stay suppressed.
+    assert len(rep.suppressed) >= 15
+
+
+def test_seeded_violation_is_caught(tmp_path):
+    """Acceptance check: a .item() seeded into a _tick is flagged."""
+    bad = tmp_path / "seeded.py"
+    bad.write_text(
+        textwrap.dedent(
+            """
+            class PagedDecodeServer:
+                def _tick(self):
+                    tok = self.nxt.item()
+                    return tok
+            """
+        )
+    )
+    rep = analyze_paths([str(bad)])
+    assert [f.rule for f in rep.findings] == ["host-sync-in-hot-loop"]
+
+
+# -- ignore mechanics --------------------------------------------------
+
+
+def _ticky(marker):
+    return textwrap.dedent(
+        f"""
+        import numpy as np
+
+
+        class S:
+            def _tick(self):
+                {marker}
+                h = np.asarray(self.nxt)
+                return h
+        """
+    )
+
+
+def test_ignore_with_reason_suppresses(tmp_path):
+    p = tmp_path / "ok.py"
+    p.write_text(
+        _ticky("# analysis: ignore[host-sync-in-hot-loop] one batched "
+               "transfer per tick by design")
+    )
+    rep = analyze_paths([str(p)], strict=True)
+    assert rep.findings == []
+    assert len(rep.suppressed) == 1
+
+
+def test_strict_flags_reasonless_ignore(tmp_path):
+    p = tmp_path / "bare.py"
+    p.write_text(_ticky("# analysis: ignore[host-sync-in-hot-loop]"))
+    lax = analyze_paths([str(p)])
+    assert lax.findings == []  # non-strict: suppression holds
+    strict = analyze_paths([str(p)], strict=True)
+    assert [f.rule for f in strict.findings] == ["ignore-without-reason"]
+
+
+def test_unknown_rule_rejected():
+    with pytest.raises(ValueError, match="unknown rules"):
+        analyze_paths([str(FIXTURES)], rules=["no-such-rule"])
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    p = tmp_path / "broken.py"
+    p.write_text("def f(:\n")
+    rep = analyze_paths([str(p)])
+    assert [f.rule for f in rep.findings] == ["parse-error"]
+
+
+# -- CLI and obs wiring ------------------------------------------------
+
+
+def test_cli_exit_codes_and_json(capsys):
+    pos = str(FIXTURES / "prng_reuse_pos.py")
+    assert main([pos, "--rules", "prng-key-reuse", "--json"]) == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["counts"] == {"prng-key-reuse": 1}
+    neg = str(FIXTURES / "prng_reuse_neg.py")
+    assert main([neg, "--rules", "prng-key-reuse"]) == 0
+    assert main(["--list-rules"]) == 0
+    assert main([pos, "--rules", "bogus"]) == 2
+
+
+def test_findings_metric_recorded():
+    rep = analyze_paths(
+        [str(FIXTURES / "obs_drift_pos.py")], rules=["obs-name-drift"]
+    )
+    reg = MetricsRegistry()
+    record_findings(rep, registry=reg)
+    assert reg.value(
+        "defer_analysis_findings_total", rule="obs-name-drift"
+    ) == 3
+    # Clean rules are published as explicit zeros, not absent.
+    assert reg.value(
+        "defer_analysis_findings_total", rule="prng-key-reuse"
+    ) == 0
+
+
+# -- trace sanitizer ---------------------------------------------------
+
+
+def test_sanitizer_detects_retrace():
+    f = jax.jit(lambda x: x + 1)
+    f(jnp.zeros((2,)))  # warmup
+    with pytest.raises(RetraceError, match="1 retrace"):
+        with sanitize(f):
+            f(jnp.zeros((3,)))  # new shape -> new trace
+
+
+def test_sanitizer_clean_block_and_report():
+    f = jax.jit(lambda x: x * 2)
+    f(jnp.zeros((2,)))
+    with sanitize(f) as rep:
+        for _ in range(3):
+            f(jnp.ones((2,)))
+    assert rep.retraces == 0
+    assert len(rep.watched) == 1
+
+
+def test_sanitizer_allow_budget():
+    f = jax.jit(lambda x: x - 1)
+    f(jnp.zeros((2,)))
+    with sanitize(f, allow=1):
+        f(jnp.zeros((3,)))  # exactly one retrace, inside budget
+
+
+def test_sanitizer_refuses_empty_watch():
+    with pytest.raises(ValueError, match="no jitted callables"):
+        with sanitize(object()):
+            pass
+
+
+def test_sanitizer_does_not_mask_block_errors():
+    f = jax.jit(lambda x: x + 1)
+    f(jnp.zeros((2,)))
+    with pytest.raises(RuntimeError, match="boom"):
+        with sanitize(f):
+            f(jnp.zeros((3,)))  # retraces, but the block's own error wins
+            raise RuntimeError("boom")
+
+
+def test_conftest_fixture_wraps_sanitizer(trace_sanitizer):
+    f = jax.jit(lambda x: x + 3)
+    f(jnp.zeros((2,)))
+    with trace_sanitizer(f) as rep:
+        f(jnp.zeros((2,)))
+    assert rep.retraces == 0
+
+
+def test_jit_cached_is_trace_stable():
+    """utils/memo.jit_cached: same static key -> the same jitted
+    callable, so re-building the closure per call costs no retrace —
+    the migration target for fresh-closure-jit findings."""
+    from defer_tpu.utils.memo import jit_cached
+
+    def make(scale):
+        def f(x):
+            return x * scale
+
+        return f
+
+    a = jit_cached(make(2.0), ("test_analysis", "stable"))
+    b = jit_cached(make(2.0), ("test_analysis", "stable"))
+    assert a is b
+    a(jnp.zeros((2,)))
+    with sanitize(a) as rep:
+        b(jnp.zeros((2,)))
+    assert rep.retraces == 0
+    # Distinct jit options are distinct cache entries.
+    c = jit_cached(make(2.0), ("test_analysis", "stable"), static_argnums=())
+    assert c is not a
+
+
+def test_paged_tick_trace_stable_after_warmup():
+    """The enforcement form of the paged server's design contract: a
+    warmed `_tick` loop lowers nothing new — 3 post-warmup ticks, zero
+    retraces across every jitted callable the server holds."""
+    from defer_tpu.models.gpt import tiny_gpt
+    from defer_tpu.runtime.paged import PagedDecodeServer
+
+    dec = tiny_gpt(64)
+    params = dec.init(jax.random.key(0))
+    srv = PagedDecodeServer(
+        dec, params, num_blocks=12, block_size=4, max_batch=2
+    )
+    srv.submit(jnp.asarray([[3, 9, 27]], jnp.int32), 10)
+    srv.submit(jnp.asarray([[5, 1]], jnp.int32), 9)
+    srv._admit()
+    for _ in range(2):  # warmup: first tick compiles the step
+        srv._tick()
+    with sanitize(srv, dec) as rep:
+        for _ in range(3):
+            srv._tick()
+    assert rep.retraces == 0
+    assert rep.watched  # the step/insert callables were actually seen
