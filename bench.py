@@ -543,6 +543,27 @@ def bench_paged_attention(devices) -> dict:
     return rec
 
 
+def bench_decode_window(devices) -> dict:
+    """Fused decode windows (scripts/bench_paged.py): the same request
+    mix served at decode_window = K for K in {1,4,8,16}, pricing host
+    dispatches per token against tokens/sec. Dispatches-per-token
+    falls toward 1/K; on dispatch-bound tiers the tokens/sec follows."""
+    import importlib.util
+    import os
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "scripts",
+        "bench_paged.py",
+    )
+    spec = importlib.util.spec_from_file_location("bench_paged", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rec = mod.run_window_sweep(devices)
+    log(f"decode window sweep: {rec}")
+    return rec
+
+
 def bench_bert(devices) -> dict:
     """Single-chip SPMD BERT-base forward throughput + MFU."""
     import jax
@@ -771,6 +792,8 @@ def run_bench() -> dict:
         "llama_decode": None,
         "decode_server": None,
         "paged_server": None,
+        "paged_attention": None,
+        "decode_window": None,
         "pallas_attention": None,
     }
     snapshot(result)
@@ -916,6 +939,7 @@ def run_bench() -> dict:
             ("decode_server", bench_decode_server),
             ("paged_server", bench_paged_server),
             ("paged_attention", bench_paged_attention),
+            ("decode_window", bench_decode_window),
             ("bert_base", bench_bert),
         ]
         # Mosaic-kernel section last. It runs wherever the pallas gate

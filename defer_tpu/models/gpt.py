@@ -909,6 +909,16 @@ class GptDecoder:
         are reused in place — the serving configuration."""
         return self._memoized(donate, self._step_fn)
 
+    def decode_step_fn(self):
+        """The RAW (unjitted) single-step body `(params, cache, ids)
+        -> (logits, cache)` — trace-compatible with `lax.scan`, so the
+        serving layer can fuse `decode_window=K` decode sub-steps into
+        one jitted window program (runtime/decode_server.py /
+        runtime/paged.py) instead of dispatching make_step K times
+        from the host. Identical math to make_step's body: a window of
+        K applications is bit-identical to K host-dispatched ticks."""
+        return self._step_fn()
+
     # -- generation --------------------------------------------------------
 
     def prefill(
@@ -1260,6 +1270,19 @@ class SpmdGptDecoder(GptDecoder):
             return step
 
         return self._memoized(donate, build)
+
+    def decode_step_fn(self):
+        # Inheriting GptDecoder's raw body would silently drop the
+        # shard_map wrapper (tp psums, vocab sharding) — the window
+        # fusion would trace but compute garbage on a mesh. Servers
+        # asked for decode_window > 1 call this at construction to
+        # fail fast instead.
+        raise NotImplementedError(
+            "decode_window > 1 is not supported under shard_map "
+            "tensor parallelism: the fused window step would bypass "
+            "SpmdGptDecoder's sharded make_step — serve with "
+            "decode_window=1"
+        )
 
     def init_cache(self, batch: int) -> dict:
         from jax.sharding import NamedSharding

@@ -123,6 +123,28 @@ class ServingMetrics:
             "defer_kv_rows_read_last_tick",
             "KV rows read by the most recent decode tick", labels,
         )
+        # Dispatch-efficiency instruments (fused decode windows,
+        # runtime/*.py `decode_window`): one host dispatch drives up
+        # to K decode sub-steps, so dispatches-per-token falls toward
+        # 1/K while tokens_per_dispatch rises toward K * active slots.
+        # At decode_window=1 host_dispatches == decode_ticks and the
+        # gauge reads the active-slot count.
+        self.host_dispatches = reg.counter(
+            "defer_host_dispatches_total",
+            "Decode-loop host dispatches (one per window; equals "
+            "decode ticks at decode_window=1)", labels,
+        )
+        self.tokens_per_dispatch = reg.gauge(
+            "defer_tokens_per_dispatch",
+            "Tokens accepted from the most recent decode dispatch",
+            labels,
+        )
+        self.window_truncated = reg.counter(
+            "defer_window_truncated_total",
+            "Decode windows a slot cut short (eos froze the row "
+            "on-device, or a stop sequence discarded the tail on "
+            "drain)", labels,
+        )
 
 
 class ServerStats(dict):

@@ -176,6 +176,20 @@ class BatchGatherer:
         return self._carry is not None
 
 
+def window_drain_order(valid_lens, width: int):
+    """Tick-major iteration order for draining a fused-decode window
+    buffer ([B, K] tokens plus per-slot valid lengths): yields (t, i)
+    for every accepted token, sub-step first and slot second, so
+    streaming callbacks fire in exactly the interleaving a
+    decode_window=1 loop produces (all slots' token t before any
+    slot's token t+1). Shared by both decode servers' window drains
+    (runtime/decode_server.py / runtime/paged.py)."""
+    for t in range(width):
+        for i, n in enumerate(valid_lens):
+            if t < n:
+                yield t, i
+
+
 def split_output(out: Any, sizes: list[int]) -> list[Any]:
     """Invert the gather: slice the batched output back into per-item
     results (device-side slices; no host transfer). Pad rows beyond

@@ -53,13 +53,16 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from defer_tpu.models.gpt import (
     sample_token_batched,
     sample_token_batched_nosort,
 )
 from defer_tpu.obs.serving import ServerStats, ServingMetrics
+from defer_tpu.runtime.batching import window_drain_order
 from defer_tpu.runtime.stopping import matcher_or_none, normalize_stops
+from defer_tpu.utils.memo import cached_step
 
 
 class SlotSampler:
@@ -184,6 +187,7 @@ class DecodeServer:
         prefix_ids: jax.Array | None = None,
         on_token: Any = None,
         eos_id: int | None = None,
+        decode_window: int = 1,
     ):
         """`on_token(request_id, token_id, done)` — optional streaming
         callback fired for every generated token as its batched tick
@@ -193,7 +197,36 @@ class DecodeServer:
         `eos_id` — stop token: a request that emits it finishes
         immediately (its output ends with the eos) and its slot
         re-admits the next queued request, so num_steps becomes a
-        budget rather than an exact length."""
+        budget rather than an exact length.
+
+        `decode_window` — decode sub-steps fused into ONE jitted host
+        dispatch (K). At the default 1 the server is the classic
+        tick-per-token loop, bit-identical to before the window path
+        existed. At K > 1 a `lax.scan` advances every active slot up
+        to K tokens on device — sampling and eos detection included —
+        and the host sees one batched [B, K] transfer per WINDOW
+        instead of one [B, 1] transfer per token; admissions and
+        retirements happen at window boundaries. Outputs stay
+        token-identical to decode_window=1 (greedy bit-identical;
+        sampled streams follow the same per-slot key schedule). A slot
+        that hits eos or its budget mid-window is frozen on device
+        (its position pinned, its tail tokens discarded on drain) —
+        the latency cost of a larger K is finishing slots idling until
+        the window boundary."""
+        if decode_window < 1:
+            raise ValueError(
+                f"decode_window must be >= 1, got {decode_window}"
+            )
+        self.decode_window = decode_window
+        if decode_window > 1:
+            raw = getattr(dec, "decode_step_fn", None)
+            if raw is None:
+                raise ValueError(
+                    "decode_window > 1 needs a decoder exposing "
+                    "decode_step_fn() (models/gpt.py GptDecoder); "
+                    f"{type(dec).__name__} does not"
+                )
+            raw()  # SpmdGptDecoder raises here: fail at construction
         self.dec = dec
         self.params = params
         self.B = max_batch
@@ -252,6 +285,11 @@ class DecodeServer:
         self.on_token = on_token
         self.eos_id = eos_id
         self.solo_steps = 0  # what per-request loops would have cost
+        # Dispatch-efficiency accounting (fused windows): host
+        # dispatches of the decode program and tokens accepted from
+        # them. At decode_window=1, dispatches == ticks.
+        self.dispatches = 0
+        self.window_tokens = 0
         # Metric handles resolved once; the tick/admission paths touch
         # pre-bound attributes only (obs/serving.py).
         self.obs = ServingMetrics("flat")
@@ -461,6 +499,8 @@ class DecodeServer:
             self._finish(i, slot)
 
     def _tick(self) -> None:
+        if self.decode_window > 1:
+            return self._tick_window()
         active = [s.req is not None for s in self.slots]
         if not any(active):
             return
@@ -468,12 +508,16 @@ class DecodeServer:
         # set their row, draws below overwrite the whole vector.
         logits, cache = self.step(self.params, self.cache, self._feed)
         self.ticks += 1
+        self.dispatches += 1
         n_active = sum(active)
         now = time.perf_counter()
         if self._last_tick_t is not None:
             self.obs.itl.observe(now - self._last_tick_t, n_active)
         self._last_tick_t = now
         self.obs.ticks.inc()
+        self.obs.host_dispatches.inc()
+        self.obs.tokens_per_dispatch.set(float(n_active))
+        self.window_tokens += n_active
         self.obs.tokens_generated.inc(n_active)
         # Inactive slots wrote a dummy row at their position; pin them
         # back to 0 so they never creep toward max_len.
@@ -497,9 +541,10 @@ class DecodeServer:
                 for s in self.slots
             )
         )
-        # analysis: ignore[host-sync-in-hot-loop] single batched [B,1]
-        # transfer, and only when an eos/stop/stream consumer needs
-        # host tokens — the sync this serving loop is designed around
+        # analysis: ignore[host-sync-in-hot-loop] single batched
+        # transfer per WINDOW (a window of one token here), and only
+        # when an eos/stop/stream consumer needs host tokens — the
+        # sync this serving loop is designed around
         host_nxt = np.asarray(nxt) if need_host else None
         for i, slot in enumerate(self.slots):
             if slot.req is None:
@@ -522,6 +567,185 @@ class DecodeServer:
                     slot.req, int(host_nxt[i]), slot.remaining == 0
                 )
             if slot.remaining == 0:
+                self._finish(i, slot)
+
+    def _build_window(self, mode: str):
+        """The fused K-sub-step decode program for one sampling mode
+        ("argmax" | "nosort" | "sort" — picked per window, same
+        bit-identical trio SlotSampler.draw switches between). A
+        `lax.scan` over the raw single-step body (decode_step_fn)
+        advances every row; each sub-step pins inactive rows' position
+        (the K=1 tick's exact rule, applied with the sub-step-START
+        active mask), samples on device, counts the token against the
+        row's budget, and freezes rows that hit eos or budget for the
+        REST of the window. Fixed length K — no early exit — so the
+        trace is stable regardless of where rows finish. Memoized on
+        the decoder (utils/memo.cached_step), which also puts it where
+        analysis/sanitizer.py auto-watches for retraces."""
+        K = self.decode_window
+        eos = self.eos_id
+        dec = self.dec
+
+        def build():
+            raw = dec.decode_step_fn()
+
+            def window(params, cache, feed, active, keys, temp,
+                       topk, topp, minp, budget):
+                def body(carry, _):
+                    cache, feed, active, keys, n = carry
+                    logits, cache = raw(params, cache, feed)
+                    cache = {
+                        **cache,
+                        "pos": jnp.where(active, cache["pos"], 0),
+                    }
+                    ll = logits[:, -1, :]
+                    if mode == "argmax":
+                        nxt = jnp.argmax(ll, axis=-1)
+                    elif mode == "nosort":
+                        nxt, keys = sample_token_batched_nosort(
+                            ll, keys, temp, minp
+                        )
+                    else:
+                        nxt, keys = sample_token_batched(
+                            ll, keys, temp, topk, topp, minp
+                        )
+                    n = n + active.astype(jnp.int32)
+                    alive = active & (n < budget)
+                    if eos is not None:
+                        alive = alive & (nxt != eos)
+                    feed = nxt[:, None].astype(jnp.int32)
+                    return (cache, feed, alive, keys, n), nxt
+
+                init = (
+                    cache, feed, active, keys,
+                    jnp.zeros_like(budget),
+                )
+                (cache, feed, alive, keys, n), toks = lax.scan(
+                    body, init, None, length=K
+                )
+                return cache, feed, alive, keys, n, toks.T
+
+            return jax.jit(window, donate_argnums=(1,))
+
+        return cached_step(
+            self.dec, ("flat_window", K, mode, eos), build
+        )
+
+    def _tick_window(self) -> None:
+        """One fused dispatch of up to decode_window tokens per active
+        slot; ONE batched host transfer drains the [B, K] token buffer
+        (plus tiny per-slot valid-length/alive vectors when eos is
+        configured)."""
+        active = [s.req is not None for s in self.slots]
+        if not any(active):
+            return
+        K = self.decode_window
+        sampling = any(
+            s.req is not None and s.sampling for s in self.slots
+        )
+        if not sampling:
+            mode = "argmax"
+        elif any(self._sampler.row_sort):
+            mode = "sort"
+        else:
+            mode = "nosort"
+        window = self._build_window(mode)
+        budget = [
+            s.remaining if s.req is not None else 0
+            for s in self.slots
+        ]
+        sm = self._sampler
+        cache, feed, alive, keys, n_dev, toks = window(
+            self.params, self.cache, self._feed,
+            jnp.asarray(active), sm.keys, sm.temp, sm.topk,
+            sm.topp, sm.minp, jnp.asarray(budget, jnp.int32),
+        )
+        self.cache = cache
+        self._feed = feed
+        sm.keys = keys
+        self.ticks += 1
+        self.dispatches += 1
+        n_live = sum(active)
+        now = time.perf_counter()
+        if self._last_tick_t is not None:
+            self.obs.itl.observe(now - self._last_tick_t, n_live)
+        self._last_tick_t = now
+        self.obs.ticks.inc()
+        self.obs.host_dispatches.inc()
+        need_toks = self.on_token is not None or any(
+            s.req is not None and s.stop is not None
+            for s in self.slots
+        )
+        if self.eos_id is not None:
+            # analysis: ignore[host-sync-in-hot-loop] one batched
+            # per-WINDOW transfer of the valid-length/alive vectors
+            # — K tokens amortize this sync, the point of the window
+            emitted = np.asarray(n_dev).tolist()
+            # analysis: ignore[host-sync-in-hot-loop] same per-window
+            # sync point (ready with the vector above)
+            alive_host = np.asarray(alive).tolist()
+        else:
+            # No eos: the device can only freeze rows on budget, which
+            # the host already knows — no transfer needed.
+            emitted = [min(b, K) for b in budget]
+            alive_host = [b > K for b in budget]
+        # analysis: ignore[host-sync-in-hot-loop] the ONE batched
+        # [B, K] token transfer per window that replaces K per-tick
+        # [B, 1] transfers — only when a stream/stop consumer exists
+        toks_host = np.asarray(toks).tolist() if need_toks else None
+        self._drain_window(toks, toks_host, emitted, alive_host,
+                           budget)
+
+    def _drain_window(
+        self, toks, toks_host, emitted, alive_host, budget
+    ) -> None:
+        """Host-side window drain, per-token-equivalent to the K=1
+        tick loop: stop sequences truncate the window's overshoot
+        (StopMatcher.push_window — discarded tokens never enter the
+        match history), budgets and finishes mirror the per-token
+        bookkeeping, and streaming callbacks fire in tick-major order
+        (batching.window_drain_order) so consumers see the exact
+        K=1 interleaving."""
+        K = self.decode_window
+        accepted = [0] * self.B
+        finishing = [False] * self.B
+        for i, slot in enumerate(self.slots):
+            if slot.req is None:
+                continue
+            n_i = emitted[i]
+            a_i = n_i
+            stopped = False
+            if slot.stop is not None:
+                hit = slot.stop.push_window(toks_host[i][:n_i])
+                if hit is not None:
+                    a_i, stopped = hit, True
+            accepted[i] = a_i
+            if a_i < min(budget[i], K):
+                self.obs.window_truncated.inc()
+            slot.remaining -= a_i
+            if stopped or not alive_host[i]:
+                # eos froze the row on device, a stop sequence cut it
+                # on drain, or its budget ran out mid-window.
+                slot.remaining = 0
+            tok_block = toks[i, :a_i][None, :].astype(
+                slot.last.dtype
+            )
+            slot.toks.append(tok_block)
+            slot.last = tok_block[:, -1:]
+            finishing[i] = slot.remaining == 0
+            self.obs.tokens_generated.inc(a_i)
+            self.window_tokens += a_i
+        self.obs.tokens_per_dispatch.set(float(sum(accepted)))
+        if self.on_token is not None:
+            for t, i in window_drain_order(accepted, K):
+                slot = self.slots[i]
+                self.on_token(
+                    slot.req,
+                    toks_host[i][t],
+                    finishing[i] and t == accepted[i] - 1,
+                )
+        for i, slot in enumerate(self.slots):
+            if finishing[i]:
                 self._finish(i, slot)
 
     def _finish(self, i: int, slot: _Slot) -> None:
@@ -547,6 +771,7 @@ def serve_greedy(
     prefix_ids: jax.Array | None = None,
     eos_id: int | None = None,
     sampling: list | None = None,
+    decode_window: int = 1,
 ) -> tuple[list[jax.Array], dict]:
     """One-shot convenience: serve `[(prompt, steps), ...]`, returning
     outputs in submission order plus stats (`ticks` batched decode
@@ -556,10 +781,17 @@ def serve_greedy(
     obs.ServerStats: the same dict plus attribute access and the
     process metrics snapshot under `stats.metrics`. With `prefix_ids`, each
     prompt is the per-request SUFFIX and outputs cover suffix +
-    generation (the prefix ids are not repeated in the result)."""
+    generation (the prefix ids are not repeated in the result).
+
+    `decode_window=K` fuses K decode sub-steps into one host dispatch
+    (DecodeServer docstring has the semantics); outputs stay
+    token-identical to the default K=1. Stats then also carry
+    `decode_window`, `host_dispatches` (decode dispatches issued) and
+    `tokens_per_dispatch` (mean tokens accepted per dispatch — the
+    dispatch-amortization win, approaching K * active slots)."""
     srv = DecodeServer(
         dec, params, max_batch=max_batch, prefix_ids=prefix_ids,
-        eos_id=eos_id,
+        eos_id=eos_id, decode_window=decode_window,
     )
     samps = sampling or [None] * len(requests)
     if len(samps) != len(requests):
@@ -577,5 +809,10 @@ def serve_greedy(
         ticks=srv.ticks,
         solo_steps=srv.solo_steps,
         saved_prefill_tokens=srv.prefix_len * len(requests),
+        decode_window=srv.decode_window,
+        host_dispatches=srv.dispatches,
+        tokens_per_dispatch=(
+            srv.window_tokens / srv.dispatches if srv.dispatches else 0.0
+        ),
     )
     return [done[r] for r in rids], stats

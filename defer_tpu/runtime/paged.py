@@ -79,8 +79,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from defer_tpu.models.gpt import (
+    sample_token_batched,
+    sample_token_batched_nosort,
+)
 from defer_tpu.obs.serving import ServerStats, ServingMetrics
 from defer_tpu.ops.pallas_attention import _MASK_VALUE
+from defer_tpu.runtime.batching import window_drain_order
 from defer_tpu.runtime.decode_server import SlotSampler
 from defer_tpu.runtime.stopping import matcher_or_none, normalize_stops
 
@@ -318,9 +323,22 @@ class PagedDecodeServer:
         prefix_ids: jax.Array | None = None,
         prefix_cache: bool = False,
         attention: str = "gathered",
+        decode_window: int = 1,
     ):
         """`on_token(request_id, token_id, done)` — optional streaming
         callback, same contract as the flat server's.
+
+        `decode_window` — decode sub-steps fused into ONE jitted host
+        dispatch (K), the paged twin of DecodeServer's parameter (its
+        docstring has the full semantics). A `lax.scan` over the raw
+        paged step advances every live slot up to K tokens on device;
+        rows frozen mid-window (eos / budget) have their position and
+        block-table row zeroed per sub-step, so their dead writes land
+        in trash block 0 row 0 — exactly where an idle K=1 slot
+        writes. One batched [B, K] transfer per window feeds
+        streaming/stop consumers; admissions and block
+        allocation/release stay at window boundaries. The default 1 is
+        the classic tick-per-token loop, bit-identical to before.
 
         `attention` — which decode attention path the tick compiles
         (module docstring): "gathered" (contiguous-view reference,
@@ -357,6 +375,11 @@ class PagedDecodeServer:
                 f"attention must be 'gathered', 'blockwise' or "
                 f"'pallas', got {attention!r}"
             )
+        if decode_window < 1:
+            raise ValueError(
+                f"decode_window must be >= 1, got {decode_window}"
+            )
+        self.decode_window = decode_window
         self.attention = attention
         self.dec = dec
         self.params = params
@@ -391,6 +414,11 @@ class PagedDecodeServer:
         self._next_id = 0
         self.ticks = 0
         self.blocks_peak = 0
+        # Dispatch-efficiency accounting (fused windows): host
+        # dispatches of the decode program and tokens accepted from
+        # them. At decode_window=1, dispatches == ticks.
+        self.dispatches = 0
+        self.window_tokens = 0
         # Metric handles resolved once; tick/admission paths touch
         # pre-bound attributes only (obs/serving.py).
         self.obs = ServingMetrics("paged")
@@ -606,6 +634,13 @@ class PagedDecodeServer:
             )
 
     def _build_step(self):
+        return jax.jit(self._step_body(), donate_argnums=(1, 2))
+
+    def _step_body(self):
+        """The RAW (unjitted) gathered-attention step body — jitted
+        standalone for the K=1 tick (_build_step) and traced inside
+        the fused-window scan (_build_window) for decode_window > 1,
+        so both paths run identical math by construction."""
         dec, bs = self.dec, self.bs
 
         def step(params, pk, pv, tables, pos, ids, adapter_ids):
@@ -645,9 +680,14 @@ class PagedDecodeServer:
             logits = dec._final_logits(params, x)
             return logits, pk, pv
 
-        return jax.jit(step, donate_argnums=(1, 2))
+        return step
 
     def _build_step_blockwise(self):
+        return jax.jit(
+            self._step_body_blockwise(), donate_argnums=(1, 2)
+        )
+
+    def _step_body_blockwise(self):
         """The block-native pure-XLA step: same embed/projection/FFN
         code as the gathered step (GptDecoder._attn_qkv/_attn_out, so
         the new K/V rows are bit-identical), but attention folds pool
@@ -693,9 +733,14 @@ class PagedDecodeServer:
             logits = dec._final_logits(params, x)
             return logits, pk, pv
 
-        return jax.jit(step, donate_argnums=(1, 2))
+        return step
 
     def _build_step_pallas(self):
+        return jax.jit(
+            self._step_body_pallas(), donate_argnums=(1, 2)
+        )
+
+    def _step_body_pallas(self):
         """The kernel variant of the block-native step: attention goes
         through ops/pallas_attention.py::paged_flash_decode, whose
         index maps resolve the block table inside the kernel grid —
@@ -747,7 +792,84 @@ class PagedDecodeServer:
             logits = dec._final_logits(params, x)
             return logits, pk, pv
 
-        return jax.jit(step, donate_argnums=(1, 2))
+        return step
+
+    def _build_window(self, mode: str):
+        """The fused K-sub-step paged decode program for one sampling
+        mode ("argmax" | "nosort" | "sort" — the bit-identical trio
+        SlotSampler.draw switches between, picked per window). A
+        `lax.scan` over the raw step body (_step_body*) advances every
+        row K times per host dispatch; each sub-step zeroes frozen
+        rows' position and block-table row (their writes land in trash
+        block 0 row 0, the idle-slot invariant), samples on device,
+        counts the token against the row's budget, and freezes rows
+        that hit eos or budget for the REST of the window. Fixed
+        length K — trace-stable regardless of where rows finish.
+        Memoized on the decoder (utils/memo.cached_step), where
+        analysis/sanitizer.py auto-watches for retraces."""
+        from defer_tpu.utils.memo import cached_step
+
+        K = self.decode_window
+        eos = self.eos_id
+        bodies = {
+            "gathered": self._step_body,
+            "blockwise": self._step_body_blockwise,
+            "pallas": self._step_body_pallas,
+        }
+        body_builder = bodies[self.attention]
+
+        def build():
+            raw = body_builder()
+
+            def window(params, pk, pv, tables, pos, feed, active,
+                       keys, temp, topk, topp, minp, budget,
+                       adapter_ids):
+                def body(carry, _):
+                    pk, pv, pos, feed, active, keys, n = carry
+                    # Frozen/idle rows: position 0 + all-trash table,
+                    # exactly the state _finish leaves a K=1 slot in.
+                    pos_eff = jnp.where(active, pos, 0)
+                    tab_eff = jnp.where(active[:, None], tables, 0)
+                    logits, pk, pv = raw(
+                        params, pk, pv, tab_eff, pos_eff, feed,
+                        adapter_ids,
+                    )
+                    ll = logits[:, -1, :]
+                    if mode == "argmax":
+                        nxt = jnp.argmax(ll, axis=-1)
+                    elif mode == "nosort":
+                        nxt, keys = sample_token_batched_nosort(
+                            ll, keys, temp, minp
+                        )
+                    else:
+                        nxt, keys = sample_token_batched(
+                            ll, keys, temp, topk, topp, minp
+                        )
+                    adv = active.astype(jnp.int32)
+                    pos = pos + adv
+                    n = n + adv
+                    alive = active & (n < budget)
+                    if eos is not None:
+                        alive = alive & (nxt != eos)
+                    feed = nxt[:, None].astype(jnp.int32)
+                    return (pk, pv, pos, feed, alive, keys, n), nxt
+
+                init = (
+                    pk, pv, pos, feed, active, keys,
+                    jnp.zeros_like(budget),
+                )
+                (pk, pv, pos, feed, alive, keys, n), toks = lax.scan(
+                    body, init, None, length=K
+                )
+                return pk, pv, feed, alive, keys, n, toks.T
+
+            return jax.jit(window, donate_argnums=(1, 2))
+
+        return cached_step(
+            self.dec,
+            ("paged_window", self.bs, self.attention, K, mode, eos),
+            build,
+        )
 
     def _build_insert(self, skip: int = 0):
         bs = self.bs
@@ -1077,6 +1199,8 @@ class PagedDecodeServer:
             )
 
     def _tick(self) -> None:
+        if self.decode_window > 1:
+            return self._tick_window()
         live = [s is not None for s in self.slots]
         if not any(live):
             return
@@ -1103,12 +1227,16 @@ class PagedDecodeServer:
             jnp.asarray(self.adapter.copy()),
         )
         self.ticks += 1
+        self.dispatches += 1
         n_live = sum(live)
         now = time.perf_counter()
         if self._last_tick_t is not None:
             self.obs.itl.observe(now - self._last_tick_t, n_live)
         self._last_tick_t = now
         self.obs.ticks.inc()
+        self.obs.host_dispatches.inc()
+        self.obs.tokens_per_dispatch.set(float(n_live))
+        self.window_tokens += n_live
         # K/V rows the attention path read this tick vs the gathered
         # baseline (host-side, exact — the counters the bandwidth win
         # is pinned by; units in obs/serving.py). "blockwise" reads
@@ -1148,9 +1276,10 @@ class PagedDecodeServer:
                 for s in self.slots
             )
         )
-        # analysis: ignore[host-sync-in-hot-loop] single batched [B,1]
-        # transfer, and only when an eos/stop/stream consumer needs
-        # host tokens — the sync this serving loop is designed around
+        # analysis: ignore[host-sync-in-hot-loop] single batched
+        # transfer per WINDOW (a window of one token here), and only
+        # when an eos/stop/stream consumer needs host tokens — the
+        # sync this serving loop is designed around
         host_nxt = np.asarray(nxt) if need_host else None
         for i, slot in enumerate(self.slots):
             if slot is None:
@@ -1163,6 +1292,171 @@ class PagedDecodeServer:
             self._emit_token(
                 i, slot, int(host_nxt[i]) if host_nxt is not None else None
             )
+
+    def _tick_window(self) -> None:
+        """One fused dispatch of up to decode_window tokens per live
+        slot (_build_window); ONE batched host transfer drains the
+        [B, K] token buffer (plus tiny valid-length/alive vectors when
+        eos is configured)."""
+        live = [s is not None for s in self.slots]
+        if not any(live):
+            return
+        self._build()
+        K = self.decode_window
+        sampling = any(
+            s is not None and s["sampling"] for s in self.slots
+        )
+        if not sampling:
+            mode = "argmax"
+        elif any(self._sampler.row_sort):
+            mode = "sort"
+        else:
+            mode = "nosort"
+        window = self._build_window(mode)
+        budget = [
+            s["remaining"] if s is not None else 0
+            for s in self.slots
+        ]
+        posm = np.where(live, self.pos, 0).astype(np.int32)
+        sm = self._sampler
+        # Same aliasing-copy rule as the K=1 tick: tables/adapter are
+        # mutated by the host (finish/admission) while the dispatched
+        # window may still be reading them.
+        (self.pool_k, self.pool_v, feed, alive, keys, n_dev,
+         toks) = window(
+            self.params, self.pool_k, self.pool_v,
+            jnp.asarray(self.tables.copy()), jnp.asarray(posm),
+            self._feed, jnp.asarray(live), sm.keys, sm.temp,
+            sm.topk, sm.topp, sm.minp,
+            jnp.asarray(budget, jnp.int32),
+            jnp.asarray(self.adapter.copy()),
+        )
+        self._feed = feed
+        sm.keys = keys
+        self.ticks += 1
+        self.dispatches += 1
+        n_live = sum(live)
+        now = time.perf_counter()
+        if self._last_tick_t is not None:
+            self.obs.itl.observe(now - self._last_tick_t, n_live)
+        self._last_tick_t = now
+        self.obs.ticks.inc()
+        self.obs.host_dispatches.inc()
+        need_toks = self.on_token is not None or any(
+            s is not None and s["stop"] is not None
+            for s in self.slots
+        )
+        if self.eos_id is not None:
+            # analysis: ignore[host-sync-in-hot-loop] one batched
+            # per-WINDOW transfer of the valid-length/alive vectors
+            # — K tokens amortize this sync, the point of the window
+            emitted = np.asarray(n_dev).tolist()
+            # analysis: ignore[host-sync-in-hot-loop] same per-window
+            # sync point (ready with the vector above)
+            alive_host = np.asarray(alive).tolist()
+        else:
+            # No eos: the device can only freeze rows on budget,
+            # which the host already knows — no transfer needed.
+            emitted = [min(b, K) for b in budget]
+            alive_host = [b > K for b in budget]
+        # analysis: ignore[host-sync-in-hot-loop] the ONE batched
+        # [B, K] token transfer per window that replaces K per-tick
+        # [B, 1] transfers — only when a stream/stop consumer exists
+        toks_host = np.asarray(toks).tolist() if need_toks else None
+        self._account_kv_rows_window(posm, emitted)
+        self._drain_window(toks, toks_host, emitted, alive_host,
+                           budget)
+
+    def _account_kv_rows_window(self, posm, emitted) -> None:
+        """Windowed K/V-row accounting: the exact host-side mirror of
+        what each attention path read across the window's K sub-steps
+        (same units/contract as the K=1 tick's accounting). A row
+        active at sub-step t (t < emitted[i]) reads at depth
+        posm[i] + t; frozen and idle rows sit at position 0 (trash
+        block), exactly as the device's pos_eff zeroing makes them."""
+        K = self.decode_window
+        bs = self.bs
+        baseline = K * self.B * self.MB * bs
+        if self.attention == "gathered":
+            rows_read = baseline
+        else:
+            # Pure-python mirror over host ints (posm/emitted are
+            # already host-side — nothing here touches the device).
+            pos_l = posm.tolist()
+            win = self.dec.cfg.window
+            rows_read = 0
+            for t in range(K):
+                pe = [
+                    p + t if t < e else 0
+                    for p, e in zip(pos_l, emitted)
+                ]
+                if self.attention == "blockwise":
+                    rows_read += (
+                        self.B * (max(pe) // bs + 1) * bs
+                    )
+                else:  # pallas
+                    rows_read += bs * sum(
+                        p // bs
+                        - (max(p - win + 1, 0) // bs
+                           if win is not None else 0)
+                        + 1
+                        for p in pe
+                    )
+        self.obs.kv_rows_read.inc(rows_read)
+        self.obs.kv_rows_gathered.inc(baseline)
+        self.obs.kv_rows_last.set(rows_read)
+
+    def _drain_window(
+        self, toks, toks_host, emitted, alive_host, budget
+    ) -> None:
+        """Host-side window drain, per-token-equivalent to the K=1
+        tick loop (flat-server _drain_window docstring has the
+        contract): stop sequences truncate overshoot, budgets and
+        finishes mirror the per-token bookkeeping, streaming fires in
+        tick-major order, and block release (_finish) happens at the
+        window boundary."""
+        K = self.decode_window
+        accepted = [0] * self.B
+        finishing = [False] * self.B
+        for i, slot in enumerate(self.slots):
+            if slot is None:
+                continue
+            n_i = emitted[i]
+            a_i = n_i
+            stopped = False
+            if slot["stop"] is not None:
+                hit = slot["stop"].push_window(toks_host[i][:n_i])
+                if hit is not None:
+                    a_i, stopped = hit, True
+            accepted[i] = a_i
+            if a_i < min(budget[i], K):
+                self.obs.window_truncated.inc()
+            slot["remaining"] -= a_i
+            if stopped or not alive_host[i]:
+                # eos froze the row on device, a stop sequence cut it
+                # on drain, or its budget ran out mid-window.
+                slot["remaining"] = 0
+            tok_block = toks[i, :a_i][None, :].astype(
+                slot["last"].dtype
+            )
+            slot["toks"].append(tok_block)
+            slot["last"] = tok_block[:, -1:]
+            self.pos[i] += a_i
+            finishing[i] = slot["remaining"] == 0
+            self.obs.tokens_generated.inc(a_i)
+            self.window_tokens += a_i
+        self.obs.tokens_per_dispatch.set(float(sum(accepted)))
+        if self.on_token is not None:
+            for t, i in window_drain_order(accepted, K):
+                slot = self.slots[i]
+                self.on_token(
+                    slot["rid"],
+                    toks_host[i][t],
+                    finishing[i] and t == accepted[i] - 1,
+                )
+        for i in range(self.B):
+            if finishing[i]:
+                self._finish(i)
 
     def _emit_token(self, i: int, slot: dict, tok: int | None) -> None:
         """Shared eos/streaming/finish bookkeeping for one emitted
@@ -1229,13 +1523,21 @@ def serve_paged(
     prefix_cache: bool = False,
     sampling: list | None = None,
     attention: str = "gathered",
+    decode_window: int = 1,
 ) -> tuple[list[jax.Array], dict]:
     """One-shot paged serving; returns (outputs in submission order,
     stats incl. peak pool usage). `adapter_ids` optionally assigns a
     LoRA adapter per request (parallel/lora.py::stack_adapters);
     `sampling` optionally assigns a SamplingParams per request;
     `attention` selects the decode attention path
-    (PagedDecodeServer docstring / module docstring)."""
+    (PagedDecodeServer docstring / module docstring).
+
+    `decode_window=K` fuses K decode sub-steps into one host dispatch
+    (PagedDecodeServer docstring has the semantics); outputs stay
+    token-identical to the default K=1. Stats then also carry
+    `decode_window`, `host_dispatches` (decode dispatches issued) and
+    `tokens_per_dispatch` (mean tokens accepted per dispatch — the
+    dispatch-amortization win, approaching K * live slots)."""
     srv = PagedDecodeServer(
         dec,
         params,
@@ -1246,6 +1548,7 @@ def serve_paged(
         prefix_ids=prefix_ids,
         prefix_cache=prefix_cache,
         attention=attention,
+        decode_window=decode_window,
     )
     aids = adapter_ids or [0] * len(requests)
     if len(aids) != len(requests):
@@ -1276,6 +1579,11 @@ def serve_paged(
         prefill_tokens_saved=srv.prefill_tokens_saved,
         cached_blocks=(
             srv.radix.cached_blocks if srv.radix is not None else 0
+        ),
+        decode_window=srv.decode_window,
+        host_dispatches=srv.dispatches,
+        tokens_per_dispatch=(
+            srv.window_tokens / srv.dispatches if srv.dispatches else 0.0
         ),
     )
     return [done[r] for r in rids], stats

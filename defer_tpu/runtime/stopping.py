@@ -67,3 +67,16 @@ class StopMatcher:
             if n >= len(s) and tuple(h[n - len(s):]) == s:
                 return True
         return False
+
+    def push_window(self, toks) -> int | None:
+        """Window drain: push a whole window's worth of one stream's
+        tokens and return the ACCEPTED count — index of the first
+        match plus one, so the output ends with the stop sequence —
+        or None if nothing matched. Tokens past the match are never
+        pushed: they are window overshoot (the device ran the rest of
+        the window blind to stop sequences) and must not pollute the
+        history a later window matches against."""
+        for j, tok in enumerate(toks):
+            if self.push(tok):
+                return j + 1
+        return None

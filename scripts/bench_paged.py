@@ -18,6 +18,11 @@ Importable: `run_microbench(devices) -> dict` — bench.py runs it as a
 "paged_attention" extras section behind the supervisor/snapshot
 deadline machinery, so a wedged compile cannot sink the headline.
 
+Also here: `run_window_sweep(devices) -> dict` (`--window-sweep` on
+the CLI) — the fused-decode-window sweep (decode_window = K in
+{1,4,8,16}) pricing host dispatches per token against tokens/sec;
+bench.py runs it as the "decode_window" extras section.
+
 "pallas" is excluded by default off-TPU: the interpret-mode kernel is
 functionally identical but interpreter-slow, which would price the
 mode's dispatch overhead, not its bandwidth. Pass --modes to force it.
@@ -162,6 +167,110 @@ def run_microbench(
     return out
 
 
+def run_window_sweep(
+    devices=None,
+    *,
+    windows: tuple = (1, 4, 8, 16),
+    num_layers: int = 4,
+    dim: int = 256,
+    num_heads: int = 8,
+    num_kv_heads: int = 4,
+    vocab_size: int = 2048,
+    max_len: int = 512,
+    num_blocks: int = 49,
+    block_size: int = 16,
+    max_batch: int = 4,
+    num_requests: int = 8,
+) -> dict:
+    """Fused-decode-window sweep: the same fixed request mix served at
+    decode_window = K for each K, through the paged server's gathered
+    path. Returns {config, windows: {K: {tokens_per_sec,
+    host_dispatches, dispatches_per_token, tokens_per_dispatch,
+    speedup_vs_k1}}}. The point being measured: every decode token
+    costs one host dispatch at K=1; a window of K amortizes that fixed
+    dispatch overhead over up to K tokens, so dispatches-per-token
+    falls toward 1/K and small-model tokens/sec — dominated by
+    dispatch overhead, not math — climbs with it."""
+    import jax
+    import jax.numpy as jnp
+
+    from defer_tpu.models.gpt import GptDecoder
+    from defer_tpu.models.llama import llama_config
+    from defer_tpu.runtime.paged import serve_paged
+
+    cfg = llama_config(
+        num_layers=num_layers,
+        dim=dim,
+        num_heads=num_heads,
+        num_kv_heads=num_kv_heads,
+        ffn_dim=dim * 2,
+        vocab_size=vocab_size,
+        max_len=max_len,
+    )
+    dec = GptDecoder(cfg, compute_dtype=jnp.bfloat16)
+    params = dec.cast_params(dec.init(jax.random.key(0)))
+    if devices:
+        params = jax.device_put(params, devices[0])
+    reqs = []
+    for i in range(num_requests):
+        t0 = 16 + (i * 23) % 112
+        steps = 16 + (i * 11) % 48
+        prompt = jax.random.randint(
+            jax.random.fold_in(jax.random.key(1), i),
+            (1, t0),
+            0,
+            cfg.vocab_size,
+        )
+        reqs.append((prompt, steps))
+    total_tokens = sum(s for _, s in reqs)
+    out: dict = {
+        "config": {
+            "num_layers": num_layers,
+            "dim": dim,
+            "heads": f"{num_heads}/{num_kv_heads}kv",
+            "max_len": max_len,
+            "num_blocks": num_blocks,
+            "block_size": block_size,
+            "max_batch": max_batch,
+            "requests": num_requests,
+            "total_tokens": total_tokens,
+        },
+        "windows": {},
+    }
+    base_tps = None
+    for K in windows:
+        def run():
+            t0 = time.perf_counter()
+            outs, stats = serve_paged(
+                dec,
+                params,
+                reqs,
+                num_blocks=num_blocks,
+                block_size=block_size,
+                max_batch=max_batch,
+                decode_window=K,
+            )
+            jax.block_until_ready(outs[-1])
+            return time.perf_counter() - t0, stats
+        run()  # compile pass
+        dt, stats = run()
+        tps = total_tokens / dt
+        if base_tps is None:
+            base_tps = tps
+        out["windows"][K] = {
+            "tokens_per_sec": round(tps, 1),
+            "host_dispatches": stats["host_dispatches"],
+            "dispatches_per_token": round(
+                stats["host_dispatches"] / total_tokens, 4
+            ),
+            "tokens_per_dispatch": round(
+                stats["tokens_per_dispatch"], 2
+            ),
+            "speedup_vs_k1": round(tps / base_tps, 3),
+        }
+    return out
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(
         description="paged-decode attention microbench (one JSON line)"
@@ -182,10 +291,19 @@ def main() -> None:
     ap.add_argument("--block-size", type=int, default=16)
     ap.add_argument("--batch", type=int, default=4)
     ap.add_argument("--requests", type=int, default=8)
+    ap.add_argument(
+        "--window-sweep",
+        action="store_true",
+        help="run the fused-decode-window sweep (decode_window = "
+        "--windows) instead of the attention-mode microbench",
+    )
+    ap.add_argument(
+        "--windows",
+        default="1,4,8,16",
+        help="comma-separated decode_window values for --window-sweep",
+    )
     args = ap.parse_args()
-    modes = tuple(m for m in args.modes.split(",") if m)
-    rec = run_microbench(
-        modes=modes,
+    shared = dict(
         num_layers=args.layers,
         dim=args.dim,
         num_heads=args.heads,
@@ -197,6 +315,14 @@ def main() -> None:
         max_batch=args.batch,
         num_requests=args.requests,
     )
+    if args.window_sweep:
+        windows = tuple(
+            int(k) for k in args.windows.split(",") if k
+        )
+        rec = run_window_sweep(windows=windows, **shared)
+    else:
+        modes = tuple(m for m in args.modes.split(",") if m)
+        rec = run_microbench(modes=modes, **shared)
     print(json.dumps(rec))
 
 

@@ -1,0 +1,37 @@
+"""NEGATIVE: the fused-window shape the decode servers actually use —
+one `lax.scan`-bodied window program dispatched per window, drained
+with device slices. The scan body is a nested def passed to lax.scan
+by VALUE (never called by name from the hot set), so hot-set
+inference must not descend into it, and nothing here syncs."""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class Server:
+    def _tick(self):
+        window = self._build_window()
+        cache, toks = window(self.params, self.cache, self.feed)
+        self.cache = cache
+        for i, slot in enumerate(self.slots):
+            # Device slice into the slot's token list — no transfer.
+            slot.toks.append(toks[i][None, :])
+
+    def _build_window(self):
+        K = self.decode_window
+        raw = self.raw_step
+
+        def window(params, cache, feed):
+            def body(carry, _):
+                cache, feed = carry
+                logits, cache = raw(params, cache, feed)
+                nxt = jnp.argmax(logits[:, -1, :], axis=-1)
+                return (cache, nxt[:, None]), nxt
+
+            (cache, feed), toks = lax.scan(
+                body, (cache, feed), None, length=K
+            )
+            return cache, toks.T
+
+        return jax.jit(window)

@@ -1,0 +1,19 @@
+"""POSITIVE: a fused decode window done WRONG — the host loops over
+the window's sub-steps in python and syncs every iteration, so the
+"window" still pays one device->host round trip per token (plus a
+blocking scalar pull per window)."""
+
+import numpy as np
+
+
+class Server:
+    def _tick(self):
+        for _ in range(self.decode_window):
+            nxt = self._advance()
+            stream = np.asarray(nxt)  # per-SUB-STEP transfer
+            self._push(stream)
+        depth = self.pos
+        self.deepest = int(depth[0])  # blocking scalar pull
+
+    def _push(self, stream):
+        self.out.extend(stream)

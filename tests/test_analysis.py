@@ -27,6 +27,7 @@ REPO = HERE.parent
 # with tests/analysis_fixtures/ (see its README).
 CASES = [
     ("host-sync-in-hot-loop", "host_sync", 2),
+    ("host-sync-in-hot-loop", "window_scan", 2),
     ("fresh-closure-jit", "fresh_closure", 2),
     ("prng-key-reuse", "prng_reuse", 1),
     ("lock-discipline", "lock_discipline", 2),

@@ -292,6 +292,68 @@ def test_paged_server_metrics_and_prefix_cache_counters():
     np.testing.assert_array_equal(np.asarray(outs[0]), np.asarray(outs[1]))
 
 
+def test_dispatch_efficiency_metrics():
+    """The fused-window instruments (runtime/*.py `decode_window`):
+    at K=1, defer_host_dispatches_total mirrors the tick counter and
+    nothing truncates; at K>1, dispatches collapse by ~K while the
+    token counters stay request-exact; an eos mid-window trips
+    defer_window_truncated_total."""
+    from defer_tpu.runtime.decode_server import serve_greedy
+
+    dec = tiny_gpt(64)
+    params = dec.init(jax.random.key(0))
+    reqs = [
+        (jnp.asarray([[3, 9, 27]], jnp.int32), 13),
+        (jnp.asarray([[5]], jnp.int32), 11),
+        (jnp.asarray([[11, 2, 8, 1, 6]], jnp.int32), 12),
+    ]
+    lab = {"server": "flat"}
+    reg = get_registry()
+    obs_reset()
+    outs, st1 = serve_greedy(dec, params, reqs, max_batch=2)
+    assert st1["decode_window"] == 1
+    assert st1["host_dispatches"] == st1["ticks"]
+    assert (
+        reg.value("defer_host_dispatches_total", **lab)
+        == reg.value("defer_decode_ticks_total", **lab)
+        == st1["ticks"]
+    )
+    assert reg.value("defer_window_truncated_total", **lab) == 0
+    assert reg.value("defer_tokens_per_dispatch", **lab) >= 1
+
+    obs_reset()
+    outs4, st4 = serve_greedy(
+        dec, params, reqs, max_batch=2, decode_window=4
+    )
+    for a, b in zip(outs, outs4):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert st4["decode_window"] == 4
+    assert st4["host_dispatches"] < st1["host_dispatches"]
+    assert (
+        reg.value("defer_host_dispatches_total", **lab)
+        == st4["host_dispatches"]
+    )
+    # Window-exact tokens: however the budgets are windowed, the
+    # accepted total equals the requested step budgets.
+    assert reg.value("defer_tokens_generated_total", **lab) == sum(
+        s for _, s in reqs
+    )
+    assert st4["tokens_per_dispatch"] > 1.0
+
+    # eos mid-window: pick a token actually generated mid-stream and
+    # re-serve with it — deterministic truncation on a cut window.
+    # Index 3, not earlier: greedy tiny_gpt repeats its first token
+    # for a few steps, and an eos equal to a request's FIRST token
+    # finishes it at admission, before any window runs.
+    t0 = reqs[0][0].shape[1]
+    eos = int(np.asarray(outs[0])[0, t0 + 3])
+    obs_reset()
+    _, _ = serve_greedy(
+        dec, params, reqs, max_batch=2, decode_window=4, eos_id=eos
+    )
+    assert reg.value("defer_window_truncated_total", **lab) > 0
+
+
 def test_batch_gatherer_flush_reason_counters():
     """BatchGatherer flush accounting: a filled batch counts as
     "full", an SLO expiry as "timeout", a sentinel as "eos", an
