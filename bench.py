@@ -564,6 +564,29 @@ def bench_decode_window(devices) -> dict:
     return rec
 
 
+def bench_disagg(devices) -> dict:
+    """Disaggregated serving (scripts/bench_disagg.py): the same
+    request mix through monolithic serve_paged and split serve_disagg
+    (prefill worker over loopback), pricing tokens/sec and TTFT
+    against the KV bytes shipped per request — lossless vs int8
+    transfer. The split/monolithic ratio and the wire bytes are the
+    headline; off-TPU the absolute throughput is noise."""
+    import importlib.util
+    import os
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "scripts",
+        "bench_disagg.py",
+    )
+    spec = importlib.util.spec_from_file_location("bench_disagg", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rec = mod.run_microbench(devices)
+    log(f"disaggregated serving: {rec}")
+    return rec
+
+
 def bench_bert(devices) -> dict:
     """Single-chip SPMD BERT-base forward throughput + MFU."""
     import jax
@@ -794,6 +817,7 @@ def run_bench() -> dict:
         "paged_server": None,
         "paged_attention": None,
         "decode_window": None,
+        "disagg": None,
         "pallas_attention": None,
     }
     snapshot(result)
@@ -940,6 +964,7 @@ def run_bench() -> dict:
             ("paged_server", bench_paged_server),
             ("paged_attention", bench_paged_attention),
             ("decode_window", bench_decode_window),
+            ("disagg", bench_disagg),
             ("bert_base", bench_bert),
         ]
         # Mosaic-kernel section last. It runs wherever the pallas gate

@@ -1,0 +1,39 @@
+"""defer_tpu.disagg — disaggregated prefill/decode serving.
+
+The DEFER deployment model (PAPER.md) applied to the two phases of LLM
+inference: prefill is compute-bound, decode is cache-read-bound, so a
+fleet serves better with the phases on SEPARATE nodes sized for each.
+The seam between them is finished KV state, streamed as pool-shaped
+blocks over the same host transport the pipeline runtime uses:
+
+  * `wire`            — the versioned KV-block wire format
+  * `prefill_worker`  — `serve_prefill()` + the
+                        `python -m defer_tpu.disagg.prefill_worker` CLI
+  * `ingest`          — `KVBlockIngest`, the decode-side drain that
+                        seats received blocks in the paged pool
+  * `api`             — `serve_disagg()`, the one-call split-serving
+                        entrypoint (token-identical greedy vs
+                        monolithic `serve_paged`)
+
+See ARCHITECTURE.md "Disaggregated serving".
+"""
+
+from defer_tpu.disagg.api import serve_disagg
+from defer_tpu.disagg.ingest import IngestError, KVBlockIngest
+from defer_tpu.disagg.prefill_worker import (
+    prefill_schedule,
+    run_prefill,
+    serve_prefill,
+)
+from defer_tpu.disagg.wire import KVPayload, WIRE_VERSION
+
+__all__ = [
+    "IngestError",
+    "KVBlockIngest",
+    "KVPayload",
+    "WIRE_VERSION",
+    "prefill_schedule",
+    "run_prefill",
+    "serve_disagg",
+    "serve_prefill",
+]

@@ -1,0 +1,426 @@
+"""KV-block wire format: finished prefill state as a transport payload.
+
+The DEFER thesis is streaming intermediate state between specialized
+nodes (PAPER.md); disaggregated serving applies it to the two phases
+of LLM inference — compute-bound prefill and cache-read-bound decode —
+by streaming finished KV *blocks* instead of activations. This module
+is the format layer: it frames per-layer K/V block tensors plus the
+metadata the decode server needs to seat them, through the existing
+`runtime/transport.py` framing (1-byte tag + length + codec frame) and
+`runtime/codec.py` compression seam, including the int8
+quantize-for-transfer mode.
+
+One dispatch stream (decode host -> prefill worker), mirroring
+`runtime/remote_stage.py`'s session shape (blob = uint8 JSON frame):
+
+    blob   hello       {magic, version, result_host/port, block_size,
+                        codec knobs, chunk_len}
+    blob   decoder     TransformerConfig + compute_dtype (the worker
+                       rebuilds its own GptDecoder — no pickle)
+    blob   params      manifest: [[path, dtype_token], ...]
+    frames              one array per manifest entry, ALWAYS lossless
+    then   per request: blob {kind: prefill, rid} + prompt frame
+    STOP               ends the session
+
+One result stream (worker -> decode host), per request ("payload"):
+
+    blob   kv meta     {kind: kv, version, rid, t0, n_blocks, layers,
+                        block_size, kv_heads, head_dim, dtype,
+                        quantized}
+    frame  logits      the last prompt position's [1, V] logits row,
+                       ALWAYS lossless (the first generated token is
+                       sampled from it — a lossy row would fork the
+                       stream vs monolithic serving)
+    frames K/V         2 * layers frames, layer-major K-then-V, each
+                       [n_blocks, kv_heads, block_size, head_dim];
+                       these ride the sender's quantize mode (int8 =
+                       the lossy transfer the reference ran as ZFP)
+
+bfloat16 tensors cross the wire as uint16 VIEWS plus a dtype token
+(the codec speaks numpy dtype strings only); the int8 quantized mode
+therefore applies to real float dtypes and bf16 ships lossless.
+
+Versioning: every blob carries `version`; readers reject mismatches
+loudly (a silent format skew would corrupt KV state, the worst kind of
+serving bug).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Iterator
+
+import numpy as np
+
+from defer_tpu.runtime.transport import ArrayReceiver, ArraySender, TransportError
+from defer_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+WIRE_VERSION = 1
+MAGIC = "defer-disagg"
+
+_BF16 = "bfloat16"
+
+
+def _bf16_dtype():
+    import ml_dtypes
+
+    return np.dtype(ml_dtypes.bfloat16)
+
+
+def to_wire_array(arr: Any) -> tuple[np.ndarray, str]:
+    """(codec-safe array, dtype token). bfloat16 — which the codec's
+    numpy dtype strings cannot express — travels as a uint16 view."""
+    a = np.asarray(arr)
+    if a.dtype == _bf16_dtype():
+        return a.view(np.uint16), _BF16
+    return a, a.dtype.name
+
+
+def from_wire_array(arr: np.ndarray, token: str) -> np.ndarray:
+    if token == _BF16:
+        return arr.view(_bf16_dtype())
+    if arr.dtype.name != token:
+        # The codec already restored the original dtype (including
+        # after int8 quantization); a mismatch means sender and
+        # receiver disagree about what was shipped.
+        raise TransportError(
+            f"frame dtype {arr.dtype.name} != declared {token}"
+        )
+    return arr
+
+
+def send_blob(sender: ArraySender, obj: dict) -> int:
+    """JSON dict -> one uint8 frame (remote_stage's blob idiom),
+    always lossless. Returns wire bytes."""
+    saved = sender.quantize
+    sender.quantize = None
+    try:
+        return sender.send(
+            np.frombuffer(json.dumps(obj).encode(), np.uint8)
+        )
+    finally:
+        sender.quantize = saved
+
+
+def read_blob(it: Iterator[np.ndarray]) -> dict | None:
+    """Next frame as a JSON dict; None at a clean stream end."""
+    try:
+        frame = next(it)
+    except StopIteration:
+        return None
+    try:
+        return json.loads(bytes(bytearray(frame)).decode())
+    except (ValueError, UnicodeDecodeError) as e:
+        raise TransportError(f"expected a JSON blob frame: {e}") from None
+
+
+def expect_blob(it: Iterator[np.ndarray], kind: str) -> dict:
+    blob = read_blob(it)
+    if blob is None:
+        raise TransportError(f"stream ended awaiting {kind!r} blob")
+    got = blob.get("kind")
+    if got != kind:
+        raise TransportError(f"expected {kind!r} blob, got {got!r}")
+    if blob.get("version") != WIRE_VERSION:
+        raise TransportError(
+            f"wire version {blob.get('version')} != {WIRE_VERSION}"
+        )
+    return blob
+
+
+def _next_frame(it: Iterator[np.ndarray], what: str) -> np.ndarray:
+    """next() that converts a mid-payload stream end into a typed
+    TransportError — and, inside generators, dodges PEP 479 turning
+    the StopIteration into an opaque RuntimeError."""
+    try:
+        return next(it)
+    except StopIteration:
+        raise TransportError(f"stream ended awaiting {what}") from None
+
+
+# -- decoder + params ------------------------------------------------------
+
+
+def decoder_to_wire(dec: Any) -> dict:
+    """GptDecoder -> a JSON-able architecture blob body. No pickle:
+    the worker reconstructs from the frozen TransformerConfig fields
+    (all JSON-able scalars/tuples)."""
+    cfg = dataclasses.asdict(dec.cfg)
+    return {
+        "cfg": cfg,
+        "compute_dtype": np.dtype(dec.compute_dtype).name,
+        "rolling_cache": bool(getattr(dec, "rolling_cache", False)),
+    }
+
+
+_DTYPE_BY_NAME = None
+
+
+def _dtype_from_name(name: str):
+    global _DTYPE_BY_NAME
+    if _DTYPE_BY_NAME is None:
+        import jax.numpy as jnp
+
+        _DTYPE_BY_NAME = {
+            "bfloat16": jnp.bfloat16,
+            "float16": jnp.float16,
+            "float32": jnp.float32,
+            "float64": jnp.float64,
+        }
+    try:
+        return _DTYPE_BY_NAME[name]
+    except KeyError:
+        raise TransportError(f"unknown compute dtype {name!r}") from None
+
+
+def decoder_from_wire(body: dict) -> Any:
+    from defer_tpu.models.gpt import GptDecoder
+    from defer_tpu.parallel.transformer_stack import TransformerConfig
+
+    cfg_d = dict(body["cfg"])
+    # JSON has no tuples; the frozen config declares one.
+    cfg_d["lora_targets"] = tuple(cfg_d.get("lora_targets", ()))
+    cfg = TransformerConfig(**cfg_d)
+    return GptDecoder(
+        cfg,
+        compute_dtype=_dtype_from_name(body["compute_dtype"]),
+        rolling_cache=body.get("rolling_cache", False),
+    )
+
+
+def flatten_params(tree: dict, prefix: str = "") -> list[tuple[str, np.ndarray]]:
+    """Nested dict-of-arrays -> sorted (slash-path, array) pairs.
+    graph/serialize.py's params_to_frames is two-level only (node/
+    param); decoder params mix leaf and dict values at the top level,
+    so this walks arbitrary nesting."""
+    out: list[tuple[str, np.ndarray]] = []
+    for key in sorted(tree):
+        if "/" in key:
+            raise ValueError(f"param key {key!r} contains the path separator")
+        val = tree[key]
+        if isinstance(val, dict):
+            out.extend(flatten_params(val, f"{prefix}{key}/"))
+        else:
+            out.append((f"{prefix}{key}", np.asarray(val)))
+    return out
+
+
+def unflatten_params(pairs: list[tuple[str, np.ndarray]]) -> dict:
+    tree: dict = {}
+    for path, arr in pairs:
+        node = tree
+        *parents, leaf = path.split("/")
+        for p in parents:
+            node = node.setdefault(p, {})
+        node[leaf] = arr
+    return tree
+
+
+def send_params(sender: ArraySender, params: dict) -> int:
+    """Manifest blob + one frame per leaf, ALWAYS lossless (same rule
+    as remote_stage.dispatch_stage: int8-roundtripped weights would
+    skew every token the worker ever prefills). Returns wire bytes."""
+    pairs = flatten_params(params)
+    manifest = []
+    frames = []
+    for path, arr in pairs:
+        wired, token = to_wire_array(arr)
+        manifest.append([path, token])
+        frames.append(wired)
+    n = send_blob(
+        sender,
+        {"kind": "params", "version": WIRE_VERSION, "manifest": manifest},
+    )
+    saved = sender.quantize
+    sender.quantize = None
+    try:
+        for wired in frames:
+            n += sender.send(wired)
+    finally:
+        sender.quantize = saved
+    return n
+
+
+def read_params(it: Iterator[np.ndarray]) -> dict:
+    blob = expect_blob(it, "params")
+    pairs = []
+    for path, token in blob["manifest"]:
+        arr = _next_frame(it, f"param frame {path!r}")
+        pairs.append((path, from_wire_array(arr, token)))
+    return unflatten_params(pairs)
+
+
+# -- dispatch stream (decode host -> prefill worker) -----------------------
+
+
+def send_hello(
+    sender: ArraySender,
+    *,
+    result_host: str,
+    result_port: int,
+    block_size: int,
+    chunk_len: int | None = None,
+) -> int:
+    """First dispatch frame: where results go and how to block them.
+    Codec knobs travel implicitly — the worker mirrors them onto its
+    result sender."""
+    return send_blob(
+        sender,
+        {
+            "kind": "hello",
+            "version": WIRE_VERSION,
+            "magic": MAGIC,
+            "result_host": result_host,
+            "result_port": result_port,
+            "block_size": block_size,
+            "chunk_len": chunk_len,
+            "compress": sender.compress,
+            "level": sender.level,
+            "quantize": sender.quantize,
+        },
+    )
+
+
+def expect_hello(it: Iterator[np.ndarray]) -> dict:
+    hello = expect_blob(it, "hello")
+    if hello.get("magic") != MAGIC:
+        raise TransportError(
+            f"dispatch stream magic {hello.get('magic')!r} != {MAGIC!r} "
+            "— is a non-disagg peer connected to this worker?"
+        )
+    return hello
+
+
+def send_prefill_request(
+    sender: ArraySender, rid: int, prompt: np.ndarray
+) -> int:
+    n = send_blob(
+        sender, {"kind": "prefill", "version": WIRE_VERSION, "rid": rid}
+    )
+    saved = sender.quantize
+    sender.quantize = None  # token ids are exact or useless
+    try:
+        n += sender.send(np.asarray(prompt, np.int32))
+    finally:
+        sender.quantize = saved
+    return n
+
+
+# -- result stream (prefill worker -> decode host) -------------------------
+
+
+@dataclasses.dataclass
+class KVPayload:
+    """One request's finished prefill state, decode-server-shaped:
+    `k`/`v` are [layers, n_blocks, kv_heads, block_size, head_dim]
+    block stacks (the pool layout minus the pool axis), `logits` the
+    [1, V] last-prompt-position row the first token is sampled from."""
+
+    rid: int
+    t0: int
+    k: np.ndarray
+    v: np.ndarray
+    logits: np.ndarray
+    wire_bytes: int = 0
+    quantized: bool = False
+
+
+def send_kv_payload(
+    sender: ArraySender, payload: KVPayload, obs: Any = None
+) -> int:
+    """Frame one payload onto the result stream. K/V frames ride the
+    sender's quantize mode; meta and the logits row are pinned
+    lossless. `obs` — optional obs.serving.DisaggMetrics to account
+    blocks/bytes against. Returns wire bytes sent."""
+    L, n_blocks, hkv, bs, dh = payload.k.shape
+    k_w, token = to_wire_array(payload.k)
+    v_w, _ = to_wire_array(payload.v)
+    quant = sender.quantize is not None and token != _BF16
+    n = send_blob(
+        sender,
+        {
+            "kind": "kv",
+            "version": WIRE_VERSION,
+            "rid": payload.rid,
+            "t0": payload.t0,
+            "n_blocks": n_blocks,
+            "layers": L,
+            "block_size": bs,
+            "kv_heads": hkv,
+            "head_dim": dh,
+            "dtype": token,
+            "quantized": quant,
+        },
+    )
+    logits_w, ltoken = to_wire_array(payload.logits)
+    saved = sender.quantize
+    sender.quantize = None
+    try:
+        n += sender.send(logits_w)
+    finally:
+        sender.quantize = saved
+    if ltoken == _BF16:
+        raise ValueError("logits row must be a real float dtype")
+    for layer in range(L):
+        n += sender.send(k_w[layer])
+        n += sender.send(v_w[layer])
+    if obs is not None:
+        obs.kv_blocks_shipped.inc(n_blocks)
+        obs.kv_bytes_sent.inc(n)
+    return n
+
+
+def iter_kv_payloads(
+    receiver: ArrayReceiver, obs: Any = None
+) -> Iterator[KVPayload]:
+    """Yield payloads off the result stream until the worker's STOP.
+    A stream that dies mid-payload raises TransportError with nothing
+    partial yielded — payload delivery is atomic, which is what makes
+    the retry path's "re-request everything undelivered" accounting
+    sound. `obs` — optional DisaggMetrics for received-byte
+    accounting."""
+    it = iter(receiver)
+    while True:
+        start = receiver.rx_frame_bytes
+        meta = read_blob(it)
+        if meta is None:
+            return
+        if meta.get("kind") != "kv":
+            raise TransportError(
+                f"expected 'kv' blob on the result stream, got "
+                f"{meta.get('kind')!r}"
+            )
+        if meta.get("version") != WIRE_VERSION:
+            raise TransportError(
+                f"wire version {meta.get('version')} != {WIRE_VERSION}"
+            )
+        logits = _next_frame(it, "logits frame")
+        L = meta["layers"]
+        token = meta["dtype"]
+        ks, vs = [], []
+        for layer in range(L):
+            ks.append(
+                from_wire_array(
+                    _next_frame(it, f"layer {layer} K frame"), token
+                )
+            )
+            vs.append(
+                from_wire_array(
+                    _next_frame(it, f"layer {layer} V frame"), token
+                )
+            )
+        nbytes = receiver.rx_frame_bytes - start
+        if obs is not None:
+            obs.kv_bytes_recv.inc(nbytes)
+        yield KVPayload(
+            rid=meta["rid"],
+            t0=meta["t0"],
+            k=np.stack(ks),
+            v=np.stack(vs),
+            logits=logits,
+            wire_bytes=nbytes,
+            quantized=meta.get("quantized", False),
+        )

@@ -17,10 +17,11 @@ from defer_tpu.obs.metrics import (
     reset,
 )
 from defer_tpu.obs.export import PeriodicDumper, prometheus_text
-from defer_tpu.obs.serving import ServerStats, ServingMetrics
+from defer_tpu.obs.serving import DisaggMetrics, ServerStats, ServingMetrics
 
 __all__ = [
     "Counter",
+    "DisaggMetrics",
     "Gauge",
     "Histogram",
     "MetricsRegistry",

@@ -147,6 +147,45 @@ class ServingMetrics:
         )
 
 
+class DisaggMetrics:
+    """Pre-bound instruments for one disaggregated-serving role.
+
+    Both halves of a prefill/decode split emit the same names and
+    differ by the `role` label ("prefill" | "decode"), mirroring the
+    `server` label convention above. Byte counters count WIRE bytes
+    (transport header + codec frame), so sent and recv agree exactly
+    on a lossless link and the sent/raw ratio prices the quantized
+    transfer mode."""
+
+    def __init__(self, role: str, registry: MetricsRegistry | None = None):
+        reg = registry if registry is not None else get_registry()
+        self.registry = reg
+        labels = {"role": role}
+        self.kv_blocks_shipped = reg.counter(
+            "defer_kv_blocks_shipped_total",
+            "Finished KV pool blocks framed onto the wire (full blocks "
+            "plus at most one tail block per request)", labels,
+        )
+        self.kv_bytes_sent = reg.counter(
+            "defer_kv_block_bytes_sent_total",
+            "Wire bytes of KV-block payload frames sent", labels,
+        )
+        self.kv_bytes_recv = reg.counter(
+            "defer_kv_block_bytes_recv_total",
+            "Wire bytes of KV-block payload frames received", labels,
+        )
+        self.ingest_wait = reg.histogram(
+            "defer_kv_ingest_wait_seconds",
+            "Received KV payload parked in the ingest queue before the "
+            "decode server admitted it", _LATENCY_BUCKETS, labels,
+        )
+        self.worker_restarts = reg.counter(
+            "defer_disagg_worker_restarts_total",
+            "Prefill worker sessions restarted after a mid-stream "
+            "transport failure", labels,
+        )
+
+
 class ServerStats(dict):
     """Dict-compatible structured stats snapshot.
 

@@ -176,6 +176,35 @@ class BatchGatherer:
         return self._carry is not None
 
 
+class TimedQueue:
+    """Thread-safe FIFO that times each item from put() to pop() into a
+    caller-supplied histogram — how long produced work sat waiting for
+    its consumer. The disagg ingest path uses this to surface
+    `defer_kv_ingest_wait_seconds` (disagg/ingest.py): prefill blocks
+    landing faster than decode admits them shows up here as a growing
+    wait, the early-warning signal for a prefill/decode capacity
+    imbalance."""
+
+    def __init__(self, histogram=None, maxsize: int = 0):
+        self._q: "queue_mod.Queue[tuple[float, Any]]" = queue_mod.Queue(
+            maxsize
+        )
+        self._hist = histogram
+
+    def put(self, item: Any) -> None:
+        self._q.put((time.monotonic(), item))
+
+    def pop(self, timeout: float | None = None) -> Any:
+        """Blocking get; raises queue.Empty on timeout like Queue.get."""
+        t_in, item = self._q.get(timeout=timeout)
+        if self._hist is not None:
+            self._hist.observe(time.monotonic() - t_in)
+        return item
+
+    def qsize(self) -> int:
+        return self._q.qsize()
+
+
 def window_drain_order(valid_lens, width: int):
     """Tick-major iteration order for draining a fused-decode window
     buffer ([B, K] tokens plus per-slot valid lengths): yields (t, i)

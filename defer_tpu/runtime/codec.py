@@ -157,6 +157,12 @@ def encode(
                 "NaN/Inf — send it losslessly instead"
             )
         scale = amax / 127.0 if amax > 0 else 1.0
+        if scale == 0.0:
+            # amax was a subnormal tiny enough that amax/127 underflows
+            # to 0.0; dividing by it would turn the whole tensor into
+            # clipped +/-127 garbage. Values this small round to zero
+            # at int8 precision anyway.
+            scale = 1.0
         q = np.clip(np.rint(a64 / scale), -127, 127).astype(np.int8)
         # _count=False: the inner int8 frame is an implementation
         # detail of THIS encode — letting it count would double-book

@@ -410,6 +410,14 @@ class PagedDecodeServer:
         self._feed = jnp.zeros((max_batch, 1), jnp.int32)
         self._sampler = SlotSampler(max_batch)
         self.pending: list[tuple] = []
+        # Externally prefilled admissions (disagg/): rid -> request
+        # entry whose "kv" field a transport ingest fills in from
+        # another thread (deliver_kv). Admission order follows
+        # _prefilled_order among entries whose KV has arrived. All
+        # POOL mutation stays on the run/_admit thread; the ingest
+        # thread only ever assigns the entry's "kv" slot.
+        self.pending_prefilled: dict[int, dict] = {}
+        self._prefilled_order: list[int] = []
         self.done: dict[int, jax.Array] = {}
         self._next_id = 0
         self.ticks = 0
@@ -574,9 +582,124 @@ class PagedDecodeServer:
         total = -(-(self.prefix_len + t0 + steps) // self.bs)
         return total - len(self.shared_blocks)
 
+    def submit_prefilled(
+        self,
+        prompt_ids: Any,
+        num_steps: int,
+        *,
+        sampling: Any = None,
+        stop: Any = None,
+    ) -> int:
+        """Register a request whose prefill runs ELSEWHERE (a disagg
+        prefill worker): the request waits in `pending_prefilled`
+        until `deliver_kv` hands over its finished KV blocks, then
+        admission seats those blocks directly in the pool — no local
+        prefill step. Same sampling/stop semantics as `submit`.
+
+        Restricted to the base model without a global shared prefix:
+        externally computed K/V can't be checked against a
+        constructor-level `prefix_ids` lane, and adapter-specific K/V
+        from a base-model worker would silently skew LoRA requests.
+        (`prefix_cache=True` composes fine — ingested full prompt
+        blocks register in the radix cache like locally prefilled
+        ones.)"""
+        if self.shared_blocks or self.prefix_len:
+            raise ValueError(
+                "externally prefilled admission does not compose with "
+                "constructor-level prefix_ids; use prefix_cache=True"
+            )
+        if self.multi_lora:
+            raise ValueError(
+                "externally prefilled admission supports the base "
+                "model only (adapter-specific K/V would need the "
+                "worker to run the same adapter banks)"
+            )
+        prompt = np.asarray(prompt_ids)
+        if prompt.ndim != 2 or prompt.shape[0] != 1:
+            raise ValueError("submit one request at a time ([1, T])")
+        if sampling is not None:
+            sampling.validate()
+            if sampling.temperature == 0:
+                sampling = None
+        stop_seqs = normalize_stops(stop)
+        t0 = prompt.shape[1]
+        if t0 < 1 or num_steps < 1:
+            raise ValueError("need at least 1 prompt token and 1 step")
+        if t0 + num_steps > self.dec.cfg.max_len:
+            raise ValueError(
+                f"prompt {t0} + steps {num_steps} exceeds max_len "
+                f"{self.dec.cfg.max_len}"
+            )
+        need = self._own_need(t0, num_steps)
+        usable = self.pool_k.shape[1] - 1
+        if need > usable:
+            raise ValueError(
+                f"request needs {need} blocks but the pool has "
+                f"{usable} usable"
+            )
+        rid = self._next_id
+        self._next_id += 1
+        self.pending_prefilled[rid] = {
+            "prompt": prompt.astype(np.int32),
+            "steps": num_steps,
+            "samp": sampling,
+            "stop": stop_seqs,
+            "kv": None,
+        }
+        self._prefilled_order.append(rid)
+        self._submit_t[rid] = time.perf_counter()
+        return rid
+
+    def deliver_kv(
+        self,
+        rid: int,
+        k_blocks: np.ndarray,
+        v_blocks: np.ndarray,
+        first_logits: np.ndarray,
+    ) -> None:
+        """Hand a pending_prefilled request its finished KV state:
+        [L, n_blocks, Hkv, bs, Dh] K/V block stacks covering the
+        prompt rows, plus the [1, V] logits row of the last prompt
+        position (the first generated token is sampled from it).
+        Thread-safe against the run loop: this only assigns the
+        entry's "kv" slot (one atomic dict write); the pool itself is
+        touched exclusively by `_admit` on the serving thread."""
+        entry = self.pending_prefilled.get(rid)
+        if entry is None:
+            raise KeyError(f"no pending prefilled request {rid}")
+        t0 = entry["prompt"].shape[1]
+        n_need = -(-t0 // self.bs)
+        cfg = self.dec.cfg
+        expect = (
+            cfg.num_layers,
+            n_need,
+            cfg.kv_heads,
+            self.bs,
+            cfg.dim // cfg.num_heads,
+        )
+        if tuple(k_blocks.shape) != expect or tuple(v_blocks.shape) != expect:
+            raise ValueError(
+                f"KV block stack shape {tuple(k_blocks.shape)}/"
+                f"{tuple(v_blocks.shape)} != expected {expect} for "
+                f"rid {rid} (t0={t0}, block_size={self.bs})"
+            )
+        if first_logits.shape != (1, cfg.vocab_size):
+            raise ValueError(
+                f"first_logits shape {tuple(first_logits.shape)} != "
+                f"(1, {cfg.vocab_size})"
+            )
+        entry["kv"] = (k_blocks, v_blocks, first_logits)
+
     def run(self) -> dict[int, jax.Array]:
-        while self.pending or any(self.slots):
+        while self.pending or self.pending_prefilled or any(self.slots):
             self._admit()
+            if not any(s is not None for s in self.slots):
+                if self.pending_prefilled:
+                    # Nothing seated and at least one request is
+                    # waiting on EXTERNAL KV delivery — yield instead
+                    # of spinning the admit/tick loop hot.
+                    time.sleep(1e-3)
+                continue
             self._tick()
         return self.done
 
@@ -1095,9 +1218,175 @@ class PagedDecodeServer:
         )
         return True
 
+    def _ensure_insert_dyn(self):
+        """The dynamic-skip insert is built lazily for radix servers
+        (_build); externally prefilled admission needs it regardless
+        of prefix_cache (skip = radix hit count, or 0), under the
+        same memo key so the two users share one compile."""
+        if self._insert_dyn is None:
+            from defer_tpu.utils.memo import cached_step
+
+            self._insert_dyn = cached_step(
+                self.dec,
+                ("paged_insert_dyn", self.bs),
+                self._build_insert_dynamic,
+            )
+        return self._insert_dyn
+
+    def _blocks_to_lane(self, blocks: np.ndarray) -> jax.Array:
+        """[L, n, Hkv, bs, Dh] block stack -> the flat [L, 1, Hkv, S,
+        Dh] lane the insert programs take, zero-padded up to a pow2
+        block count (capped at MB) so ingest admissions draw from the
+        same bounded compile-shape set as pow2-padded prefill."""
+        L, n, hkv, bs, dh = blocks.shape
+        n_pad = 1 << max(n - 1, 0).bit_length()
+        n_pad = min(max(n_pad, 1), self.MB)
+        if n_pad > n:
+            blocks = np.concatenate(
+                [
+                    blocks,
+                    np.zeros((L, n_pad - n, hkv, bs, dh), blocks.dtype),
+                ],
+                axis=1,
+            )
+        lane = blocks.transpose(0, 2, 1, 3, 4).reshape(
+            L, hkv, n_pad * bs, dh
+        )
+        return jnp.asarray(lane[:, None])
+
+    def _admit_prefilled(self, i: int, rid: int, entry: dict) -> bool:
+        """Seat a request whose KV arrived from a prefill worker:
+        no prefill step runs here — the delivered block stacks scatter
+        straight into allocated pool blocks (dynamic-skip insert, so
+        radix HIT blocks are never rewritten), the first token is
+        drawn from the shipped logits row, and fresh full prompt
+        blocks register in the radix cache exactly like locally
+        prefilled ones (cross-host prefix sharing: a later LOCAL
+        request can hit blocks this host never prefilled). Returns
+        False when the pool can't cover the request even after
+        eviction (it stays pending)."""
+        prompt = entry["prompt"]
+        steps = entry["steps"]
+        samp = entry["samp"]
+        k_blocks, v_blocks, first_logits = entry["kv"]
+        bs = self.bs
+        t0 = prompt.shape[1]
+        n_full = t0 // bs
+        total = -(-(t0 + steps) // bs)
+        if self.radix is not None:
+            hits, keys, toks = self.radix.walk(prompt[0], n_full, bs)
+        else:
+            hits, keys, toks = [], [], []
+        need = total - len(hits)
+        if self.radix is not None and need > len(self.free):
+            self.free.extend(self.radix.evict(need - len(self.free)))
+        if need > len(self.free):
+            for blk in hits:
+                self.radix.release(blk)
+            return False
+        own = [self.free.pop() for _ in range(need)]
+        self.obs.requests_admitted.inc()
+        if self.radix is not None:
+            self.obs.prefix_hits.inc(len(hits))
+            self.obs.prefix_misses.inc(n_full - len(hits))
+        self.obs.queue_wait.observe(
+            time.perf_counter()
+            - self._submit_t.get(rid, time.perf_counter())
+        )
+        self._build()
+        insert_dyn = self._ensure_insert_dyn()
+        table_row = np.zeros((self.MB,), np.int32)
+        for j, blk in enumerate(hits + own):
+            table_row[j] = blk
+        self.pool_k, self.pool_v = insert_dyn(
+            self.pool_k,
+            self.pool_v,
+            self._blocks_to_lane(k_blocks),
+            self._blocks_to_lane(v_blocks),
+            jnp.asarray(table_row),
+            jnp.asarray(len(hits), jnp.int32),
+        )
+        if self.radix is not None:
+            for j in range(len(hits), n_full):
+                displaced = self.radix.register(
+                    keys[j], toks[j], int(table_row[j])
+                )
+                if displaced is not None:
+                    self.free.append(displaced)
+            shared = hits + [
+                int(table_row[j]) for j in range(len(hits), n_full)
+            ]
+            owned = [int(table_row[j]) for j in range(n_full, total)]
+            self.blocks_peak = max(self.blocks_peak, self.blocks_in_use)
+        else:
+            shared = None
+            owned = own
+            self.blocks_peak = max(
+                self.blocks_peak, self.blocks_in_use + need
+            )
+        first = self._sampler.admit_first(
+            i, samp, jnp.asarray(first_logits), jnp.int32
+        )
+        self.tables[i] = table_row
+        self.pos[i] = t0
+        self.adapter[i] = 0
+        slot = {
+            "rid": rid,
+            "remaining": steps - 1,
+            "last": first,
+            "toks": [jnp.asarray(prompt), first],
+            "blocks": owned,
+            "sampling": samp is not None,
+            "stop": matcher_or_none(entry["stop"]),
+        }
+        if shared is not None:
+            slot["shared"] = shared
+        self.slots[i] = slot
+        self._feed = self._feed.at[i].set(first[0].astype(jnp.int32))
+        self.obs.ttft.observe(
+            time.perf_counter()
+            - self._submit_t.pop(rid, time.perf_counter())
+        )
+        self._update_pool_gauges()
+        need_host = (
+            self.eos_id is not None
+            or self.on_token is not None
+            or slot["stop"] is not None
+        )
+        self._emit_token(
+            i, slot, int(first[0, 0]) if need_host else None
+        )
+        return True
+
+    def _admit_prefilled_ready(self, i: int) -> bool | None:
+        """Try to seat the oldest DELIVERED prefilled request in slot
+        i. True = seated; False = one was ready but the pool can't
+        cover it (caller should wait for a finisher); None = nothing
+        deliverable right now."""
+        for rid in self._prefilled_order:
+            entry = self.pending_prefilled[rid]
+            if entry["kv"] is None:
+                continue
+            if not self._admit_prefilled(i, rid, entry):
+                return False
+            self._prefilled_order.remove(rid)
+            del self.pending_prefilled[rid]
+            return True
+        return None
+
     def _admit(self) -> None:
         for i in range(self.B):
-            if self.slots[i] is not None or not self.pending:
+            if self.slots[i] is not None:
+                continue
+            # Externally prefilled requests seat first: their compute
+            # is already spent, so every tick they wait is pure added
+            # TTFT.
+            seated = self._admit_prefilled_ready(i)
+            if seated:
+                continue
+            if seated is False:
+                return  # pool exhausted even after eviction
+            if not self.pending:
                 continue
             (rid, prompt, steps, adapter_id, samp,
              stop_seqs) = self.pending[0]

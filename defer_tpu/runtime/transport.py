@@ -74,7 +74,16 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     view = memoryview(buf)
     got = 0
     while got < n:
-        r = sock.recv_into(view[got:], n - got)
+        try:
+            r = sock.recv_into(view[got:], n - got)
+        except socket.timeout:
+            # A configured read timeout (ArrayReceiver read_timeout_s)
+            # turns a dead/stalled peer into a typed error the caller
+            # can retry around, instead of a forever-blocked recv.
+            _obs_timeouts.inc()
+            raise TransportError(
+                f"read timed out mid-frame ({got}/{n} bytes)"
+            ) from None
         if r == 0:
             raise TransportError("peer closed mid-frame")
         got += r
@@ -98,7 +107,15 @@ class ArraySender:
         quantize: str | None = None,
         connect_timeout_s: float = 30.0,
         retries: int = 10,
+        backoff_base_s: float = 0.1,
+        backoff_cap_s: float = 2.0,
     ):
+        """`retries` failed connect attempts are spaced by exponential
+        backoff: backoff_base_s * 2**attempt, capped at backoff_cap_s.
+        With the defaults a peer that is merely slow to bind (cold
+        Python+JAX start) is absorbed as a bounded queue-wait; a peer
+        that never appears surfaces as TransportError after
+        ~retries * backoff_cap_s seconds instead of hanging."""
         self.compress = compress
         self.level = level
         # Lossy int8 quantize-for-transfer (codec.SCHEME_Q8) — the DCN
@@ -109,6 +126,11 @@ class ArraySender:
             # mid-stream.
             raise ValueError(f"unknown quantize mode {quantize!r}")
         self.quantize = quantize
+        if backoff_base_s < 0 or backoff_cap_s < backoff_base_s:
+            raise ValueError(
+                f"need 0 <= backoff_base_s <= backoff_cap_s, got "
+                f"{backoff_base_s}/{backoff_cap_s}"
+            )
         last: Exception | None = None
         for attempt in range(retries):
             try:
@@ -119,7 +141,9 @@ class ArraySender:
             except OSError as e:
                 last = e
                 _obs_retries.inc()
-                threading.Event().wait(min(0.1 * 2**attempt, 2.0))
+                threading.Event().wait(
+                    min(backoff_base_s * 2**attempt, backoff_cap_s)
+                )
         else:
             _obs_timeouts.inc()
             raise TransportError(
@@ -128,7 +152,11 @@ class ArraySender:
         self._sock.settimeout(None)
         self._lock = threading.Lock()
 
-    def send(self, arr: np.ndarray) -> None:
+    def send(self, arr: np.ndarray) -> int:
+        """Frame and write one array; returns the frame's wire bytes
+        (header + codec payload) so callers can account per-stream
+        traffic (e.g. disagg/wire.py's KV-block byte counters) on top
+        of the process-global transport counters."""
         # level=0 is the codec's raw-passthrough scheme.
         a = np.asarray(arr)
         quant = (
@@ -156,7 +184,9 @@ class ArraySender:
             # concurrent senders must queue behind the write
             self._sock.sendall(_HEADER.pack(_TAG_ARRAY, len(frame)) + frame)
         _obs_tx_frames.inc()
-        _obs_tx_bytes.inc(_HEADER.size + len(frame))
+        nbytes = _HEADER.size + len(frame)
+        _obs_tx_bytes.inc(nbytes)
+        return nbytes
 
     def close(self) -> None:
         """Send the STOP frame (the graceful shutdown the reference
@@ -185,14 +215,26 @@ class ArrayReceiver:
         *,
         host: str = "0.0.0.0",
         accept_timeout_s: float = 120.0,
+        read_timeout_s: float | None = None,
     ):
+        """`read_timeout_s` bounds every in-stream recv on the accepted
+        connection: a peer that connects and then stalls (or dies
+        without a FIN reaching us) surfaces as a TransportError after
+        this many silent seconds instead of blocking forever. None
+        keeps the historical block-forever behavior for links where
+        arbitrarily long gaps between frames are legitimate."""
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._server.bind((host, port))
         self._server.listen(1)
         self._server.settimeout(accept_timeout_s)
         self.port = self._server.getsockname()[1]
+        self.read_timeout_s = read_timeout_s
         self._conn: socket.socket | None = None
+        # Cumulative wire bytes read off accepted connections —
+        # per-stream accounting for callers that need more than the
+        # process-global counters (survives next_peer handoffs).
+        self.rx_frame_bytes = 0
 
     def _accept(self) -> socket.socket:
         if self._conn is None:
@@ -203,7 +245,7 @@ class ArrayReceiver:
                 raise TransportError(
                     "no peer connected within the accept timeout"
                 ) from None
-            self._conn.settimeout(None)
+            self._conn.settimeout(self.read_timeout_s)
             log.info("transport: accepted peer %s", peer)
         return self._conn
 
@@ -215,9 +257,11 @@ class ArrayReceiver:
                 return
             if tag != _TAG_ARRAY:
                 raise TransportError(f"unknown frame tag {tag!r}")
+            payload = _recv_exact(conn, length)
             _obs_rx_frames.inc()
             _obs_rx_bytes.inc(_HEADER.size + length)
-            yield codec.decode(_recv_exact(conn, length))
+            self.rx_frame_bytes += _HEADER.size + length
+            yield codec.decode(payload)
 
     def next_peer(self) -> None:
         """Drop the current peer and accept a fresh one on the same

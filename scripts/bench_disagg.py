@@ -1,0 +1,222 @@
+#!/usr/bin/env python
+"""Disaggregated-serving microbench: the same request mix through
+monolithic `serve_paged` and split `serve_disagg` (prefill worker on a
+loopback thread), printed as ONE JSON line.
+
+The point being measured: the split buys placement freedom (prefill
+and decode sized/scaled separately) at the price of shipping finished
+KV state over the wire. This bench prices that wire: tokens/sec split
+vs monolithic, mean TTFT (which now includes a network round trip),
+and bytes-on-wire per request — lossless vs `quantize="int8"` KV
+transfer (codec SCHEME_Q8), which is where the byte bill gets paid.
+
+Standalone:
+
+    JAX_PLATFORMS=cpu python scripts/bench_disagg.py
+    python scripts/bench_disagg.py --no-int8 --requests 4
+
+Importable: `run_microbench(devices) -> dict` — bench.py runs it as a
+"disagg" extras section behind the supervisor/snapshot deadline
+machinery, so a wedged worker cannot sink the headline.
+
+Off-TPU the absolute tokens/sec is meaningless; the split/monolithic
+ratio and the per-request wire bytes are the headline numbers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _ttft_state(reg) -> dict:
+    snap = reg.value("defer_ttft_seconds", server="paged")
+    return snap if snap else {"count": 0, "sum": 0.0}
+
+
+def _ttft_mean_since(reg, before: dict) -> float | None:
+    now = _ttft_state(reg)
+    n = now["count"] - before["count"]
+    return (now["sum"] - before["sum"]) / n if n else None
+
+
+def run_microbench(
+    devices=None,
+    *,
+    int8: bool = True,
+    num_layers: int = 4,
+    dim: int = 256,
+    num_heads: int = 8,
+    num_kv_heads: int = 4,
+    vocab_size: int = 2048,
+    max_len: int = 512,
+    num_blocks: int = 49,
+    block_size: int = 16,
+    max_batch: int = 4,
+    num_requests: int = 6,
+) -> dict:
+    """Serve one fixed request mix monolithically and split; returns
+    {config, monolithic: {...}, disagg: {...}, disagg_int8: {...}}.
+    Deliberately small defaults — on CPU the interesting numbers are
+    the split/monolithic ratio and the wire bytes, not throughput."""
+    import jax
+    import jax.numpy as jnp
+
+    from defer_tpu.disagg import serve_disagg
+    from defer_tpu.models.gpt import GptDecoder
+    from defer_tpu.models.llama import llama_config
+    from defer_tpu.obs import get_registry
+    from defer_tpu.runtime.paged import serve_paged
+
+    cfg = llama_config(
+        num_layers=num_layers,
+        dim=dim,
+        num_heads=num_heads,
+        num_kv_heads=num_kv_heads,
+        ffn_dim=dim * 2,
+        vocab_size=vocab_size,
+        max_len=max_len,
+    )
+    # float32 compute on purpose: bfloat16 KV travels as a lossless
+    # uint16 view the Q8 codec skips (wire.to_wire_array), so a bf16
+    # model would make the int8 variant a silent no-op.
+    dec = GptDecoder(cfg, compute_dtype=jnp.float32)
+    params = dec.cast_params(dec.init(jax.random.key(0)))
+    if devices:
+        params = jax.device_put(params, devices[0])
+    reqs = []
+    for i in range(num_requests):
+        t0 = 16 + (i * 23) % 112
+        steps = 16 + (i * 11) % 48
+        prompt = jax.random.randint(
+            jax.random.fold_in(jax.random.key(1), i),
+            (1, t0),
+            0,
+            cfg.vocab_size,
+        )
+        reqs.append((prompt, steps))
+    total_tokens = sum(s for _, s in reqs)
+    prompt_tokens = sum(int(p.shape[1]) for p, _ in reqs)
+    reg = get_registry()
+    shared = dict(
+        num_blocks=num_blocks,
+        block_size=block_size,
+        max_batch=max_batch,
+    )
+
+    out: dict = {
+        "config": {
+            "num_layers": num_layers,
+            "dim": dim,
+            "heads": f"{num_heads}/{num_kv_heads}kv",
+            "max_len": max_len,
+            "num_blocks": num_blocks,
+            "block_size": block_size,
+            "max_batch": max_batch,
+            "requests": num_requests,
+            "total_tokens": total_tokens,
+            "prompt_tokens": prompt_tokens,
+        },
+    }
+
+    def timed(serve):
+        before = _ttft_state(reg)
+        t0 = time.perf_counter()
+        outs, stats = serve()
+        jax.block_until_ready(outs[-1])
+        dt = time.perf_counter() - t0
+        ttft = _ttft_mean_since(reg, before)
+        return dt, stats, ttft
+
+    def mono():
+        return serve_paged(dec, params, reqs, **shared)
+
+    timed(mono)  # compile pass
+    dt, stats, ttft = timed(mono)
+    mono_tps = total_tokens / dt
+    out["monolithic"] = {
+        "tokens_per_sec": round(mono_tps, 1),
+        "mean_ttft_s": round(ttft, 4) if ttft is not None else None,
+        "ticks": stats["ticks"],
+    }
+
+    variants = [("disagg", None)] + ([("disagg_int8", "int8")] if int8 else [])
+    lossless_bytes = None
+    for key, quantize in variants:
+        def split():
+            return serve_disagg(
+                dec, params, reqs, quantize=quantize, **shared
+            )
+
+        timed(split)  # compile pass (worker + decode paths)
+        dt, stats, ttft = timed(split)
+        tps = total_tokens / dt
+        rec = {
+            "tokens_per_sec": round(tps, 1),
+            "split_vs_monolithic": round(tps / mono_tps, 3),
+            "mean_ttft_s": round(ttft, 4) if ttft is not None else None,
+            "ticks": stats["ticks"],
+            "kv_bytes_recv": stats["kv_bytes_recv"],
+            "kv_bytes_recv_per_request": int(
+                stats["kv_bytes_recv_per_request"]
+            ),
+            "kv_bytes_per_prompt_token": round(
+                stats["kv_bytes_recv"] / prompt_tokens, 1
+            ),
+            "dispatch_bytes_sent": stats["dispatch_bytes_sent"],
+        }
+        if quantize is None:
+            lossless_bytes = stats["kv_bytes_recv"]
+        elif lossless_bytes:
+            rec["bytes_vs_lossless"] = round(
+                stats["kv_bytes_recv"] / lossless_bytes, 3
+            )
+        out[key] = rec
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        description="disaggregated-serving microbench (one JSON line)"
+    )
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--dim", type=int, default=256)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--kv-heads", type=int, default=4)
+    ap.add_argument("--vocab", type=int, default=2048)
+    ap.add_argument("--max-len", type=int, default=512)
+    ap.add_argument("--blocks", type=int, default=49)
+    ap.add_argument("--block-size", type=int, default=16)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--requests", type=int, default=6)
+    ap.add_argument(
+        "--no-int8",
+        action="store_true",
+        help="skip the quantize='int8' KV-transfer variant",
+    )
+    args = ap.parse_args()
+    rec = run_microbench(
+        int8=not args.no_int8,
+        num_layers=args.layers,
+        dim=args.dim,
+        num_heads=args.heads,
+        num_kv_heads=args.kv_heads,
+        vocab_size=args.vocab,
+        max_len=args.max_len,
+        num_blocks=args.blocks,
+        block_size=args.block_size,
+        max_batch=args.batch,
+        num_requests=args.requests,
+    )
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()

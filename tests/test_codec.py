@@ -165,3 +165,56 @@ def test_q8_rejects_non_finite_and_bad_sender_mode(native):
 
     with pytest.raises(ValueError, match="unknown quantize"):
         ArraySender("127.0.0.1", 1, quantize="int4")
+
+
+def test_q8_fuzz_kv_shaped_round_trip():
+    """Round-trip fuzz for the int8 path on KV-block-shaped tensors
+    (the disagg transfer payload, [L, n_blocks, Hkv, bs, Dh]): odd
+    block tails (zero-padded rows), empty stacks, both float dtypes,
+    and tiny-magnitude tensors whose amax/127 would underflow to a
+    zero scale without the encoder's guard. Runs on whichever backend
+    is available — the scheme is backend-agnostic."""
+    rng = np.random.default_rng(42)
+    shapes = [
+        (2, 1, 1, 4, 8),    # single block
+        (2, 3, 2, 4, 8),    # odd block count
+        (4, 2, 1, 16, 4),   # serving-default block_size
+        (2, 0, 2, 4, 8),    # empty stack (zero blocks)
+    ]
+    for shape in shapes:
+        for dtype in (np.float32, np.float16):
+            arr = (rng.standard_normal(shape) * 2.5).astype(dtype)
+            if arr.size:
+                # zero-pad a tail block's later rows, like a prompt
+                # that does not fill its last block
+                arr[:, -1:, :, 2:, :] = 0
+            out = codec.decode(codec.encode(arr, quantize="int8"))
+            assert out.dtype == dtype and out.shape == arr.shape
+            if arr.size == 0:
+                continue
+            step = float(np.abs(arr.astype(np.float64)).max()) / 127.0
+            err = float(
+                np.abs(out.astype(np.float64) - arr.astype(np.float64)).max()
+            )
+            # float16 re-rounds the dequantized value onto its own
+            # grid: allow an extra half-ulp of the largest magnitude.
+            slack = (
+                step * 0.5 + np.spacing(np.float16(np.abs(arr).max()))
+                if dtype == np.float16
+                else step * 0.5
+            )
+            assert err <= slack * (1 + 1e-6), (shape, dtype, err, slack)
+            # exact-zero rows stay exactly zero (0 / scale rounds to 0)
+            np.testing.assert_array_equal(
+                out[:, -1:, :, 2:, :], np.zeros_like(out[:, -1:, :, 2:, :])
+            )
+
+
+def test_q8_subnormal_scale_guard():
+    """amax small enough that amax/127 underflows to 0.0 must not
+    divide by zero into clipped +/-127 garbage — values this small
+    round to zero at int8 precision."""
+    tiny = np.full((3, 3), 4e-324, np.float64)  # smallest subnormal
+    out = codec.decode(codec.encode(tiny, quantize="int8"))
+    assert np.all(np.isfinite(out))
+    assert float(np.abs(out).max()) <= 4e-324

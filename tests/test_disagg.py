@@ -1,0 +1,394 @@
+"""Disaggregated prefill/decode serving: the split must be invisible.
+
+`serve_disagg` ships prefill to a worker and streams KV blocks back
+over loopback sockets; greedy outputs must be TOKEN-IDENTICAL to
+monolithic `serve_paged` across the attention-mode x prefix-cache
+matrix (the wire format and the external-admission seam may not perturb
+a single token), the retry path must survive a worker dying
+mid-stream, and ingested blocks must seed the LOCAL radix cache
+(cross-host prefix sharing)."""
+
+import queue as queue_mod
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from defer_tpu.disagg import (
+    KVBlockIngest,
+    prefill_schedule,
+    serve_disagg,
+    serve_prefill,
+)
+from defer_tpu.disagg import wire
+from defer_tpu.models.gpt import SamplingParams, tiny_gpt
+from defer_tpu.runtime.paged import PagedDecodeServer, serve_paged
+from defer_tpu.runtime.transport import (
+    ArrayReceiver,
+    ArraySender,
+    TransportError,
+)
+
+
+@pytest.fixture(scope="module")
+def model():
+    dec = tiny_gpt(64)
+    params = dec.init(jax.random.key(0))
+    return dec, params
+
+
+def _requests(vocab):
+    return [
+        (jnp.asarray([[3, 9, 27, 1, 4, 4, 2, 8]], jnp.int32) % vocab, 7),
+        (jnp.asarray([[5, 1]], jnp.int32), 4),
+        (jnp.asarray([[11, 2, 8, 1, 6]], jnp.int32) % vocab, 6),
+    ]
+
+
+# -- wire format unit tests ------------------------------------------------
+
+
+def test_prefill_schedule():
+    assert prefill_schedule(7, None) == [7]
+    assert prefill_schedule(7, 16) == [7]
+    assert prefill_schedule(8, 4) == [4, 4]
+    assert prefill_schedule(9, 4) == [4, 4, 1]
+    assert prefill_schedule(1, 4) == [1]
+    with pytest.raises(ValueError):
+        prefill_schedule(0, None)
+    with pytest.raises(ValueError):
+        prefill_schedule(5, 0)
+
+
+def test_bf16_wire_view_round_trip():
+    import ml_dtypes
+
+    a = np.arange(12, dtype=np.float32).astype(ml_dtypes.bfloat16)
+    wired, token = wire.to_wire_array(a)
+    assert wired.dtype == np.uint16 and token == "bfloat16"
+    back = wire.from_wire_array(wired, token)
+    np.testing.assert_array_equal(back, a)
+    # dtype skew between declaration and frame is loud, not silent
+    with pytest.raises(TransportError, match="dtype"):
+        wire.from_wire_array(np.zeros(3, np.float32), "float64")
+
+
+def test_params_flatten_round_trip():
+    tree = {
+        "emb": np.arange(6, dtype=np.float32).reshape(2, 3),
+        "stack": {
+            "w": np.ones((2, 2), np.float16),
+            "inner": {"b": np.zeros(4, np.int32)},
+        },
+    }
+    pairs = wire.flatten_params(tree)
+    assert [p for p, _ in pairs] == ["emb", "stack/inner/b", "stack/w"]
+    back = wire.unflatten_params(pairs)
+    np.testing.assert_array_equal(back["stack"]["inner"]["b"], tree["stack"]["inner"]["b"])
+    with pytest.raises(ValueError, match="separator"):
+        wire.flatten_params({"a/b": np.zeros(1)})
+
+
+def test_decoder_wire_round_trip(model):
+    dec, _ = model
+    body = wire.decoder_to_wire(dec)
+    dec2 = wire.decoder_from_wire(body)
+    assert dec2.cfg == dec.cfg
+    assert dec2.compute_dtype == dec.compute_dtype
+
+
+def test_kv_payload_loopback_round_trip(model):
+    """One payload through real sockets: meta, logits, and every
+    per-layer K/V frame survive framing + codec bit-exactly."""
+    dec, _ = model
+    L, hkv = dec.cfg.num_layers, dec.cfg.kv_heads
+    dh = dec.cfg.dim // dec.cfg.num_heads
+    rng = np.random.default_rng(7)
+    pay = wire.KVPayload(
+        rid=3,
+        t0=6,
+        k=rng.standard_normal((L, 2, hkv, 4, dh)).astype(np.float32),
+        v=rng.standard_normal((L, 2, hkv, 4, dh)).astype(np.float32),
+        logits=rng.standard_normal((1, dec.cfg.vocab_size)).astype(
+            np.float32
+        ),
+    )
+    recv = ArrayReceiver(0, host="127.0.0.1", accept_timeout_s=10.0)
+    got = []
+
+    def drain():
+        got.extend(wire.iter_kv_payloads(recv))
+
+    t = threading.Thread(target=drain)
+    t.start()
+    send = ArraySender("127.0.0.1", recv.port)
+    n = wire.send_kv_payload(send, pay)
+    send.close()
+    t.join(timeout=10)
+    recv.close()
+    assert len(got) == 1
+    out = got[0]
+    assert (out.rid, out.t0) == (3, 6)
+    np.testing.assert_array_equal(out.k, pay.k)
+    np.testing.assert_array_equal(out.v, pay.v)
+    np.testing.assert_array_equal(out.logits, pay.logits)
+    # sender-side wire accounting == receiver-side
+    assert out.wire_bytes == n == recv.rx_frame_bytes
+
+
+# -- end-to-end parity -----------------------------------------------------
+
+
+@pytest.mark.parametrize("attention", ["gathered", "blockwise"])
+@pytest.mark.parametrize("prefix_cache", [False, True])
+def test_disagg_token_identical_to_monolithic(
+    model, attention, prefix_cache
+):
+    """The acceptance bar: greedy outputs equal serve_paged's across
+    the attention x prefix_cache matrix."""
+    dec, params = model
+    reqs = _requests(dec.cfg.vocab_size)
+    kw = dict(
+        num_blocks=16, block_size=4, max_batch=2,
+        prefix_cache=prefix_cache, attention=attention,
+    )
+    mono, _ = serve_paged(dec, params, reqs, **kw)
+    outs, stats = serve_disagg(dec, params, reqs, **kw)
+    for i, (a, b) in enumerate(zip(mono, outs)):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b),
+            err_msg=f"attention={attention} prefix_cache={prefix_cache} "
+                    f"request {i}",
+        )
+    assert stats["disagg"] is True
+    assert stats["kv_bytes_recv"] > 0
+    assert stats["worker_restarts"] == 0
+
+
+def test_disagg_chunked_prefill_parity(model):
+    """chunk_len splits the worker's prefill into fixed-size chunks;
+    the cache rows (and therefore every decoded token) must not
+    move."""
+    dec, params = model
+    reqs = _requests(dec.cfg.vocab_size)
+    mono, _ = serve_paged(
+        dec, params, reqs, num_blocks=16, block_size=4, max_batch=2
+    )
+    outs, _ = serve_disagg(
+        dec, params, reqs, num_blocks=16, block_size=4, max_batch=2,
+        chunk_len=3,  # odd: exercises full chunks + a padded tail
+    )
+    for i, (a, b) in enumerate(zip(mono, outs)):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=f"request {i}"
+        )
+
+
+def test_disagg_sampled_request_parity(model):
+    """Seeded sampling draws from the SHIPPED logits row — the first
+    token and the whole stream must match monolithic serving."""
+    dec, params = model
+    reqs = _requests(dec.cfg.vocab_size)
+    samps = [
+        SamplingParams(temperature=0.8, top_k=8, seed=11),
+        None,
+        SamplingParams(temperature=1.1, top_p=0.9, seed=3),
+    ]
+    mono, _ = serve_paged(
+        dec, params, reqs, num_blocks=16, block_size=4, max_batch=2,
+        sampling=samps,
+    )
+    outs, _ = serve_disagg(
+        dec, params, reqs, num_blocks=16, block_size=4, max_batch=2,
+        sampling=samps,
+    )
+    for i, (a, b) in enumerate(zip(mono, outs)):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=f"request {i}"
+        )
+
+
+def test_disagg_int8_transfer_completes(model):
+    """quantize='int8' is the lossy KV transfer mode: outputs may
+    drift from lossless (the point of keeping it opt-in), but the
+    stream must stay well-formed and ship fewer bytes."""
+    dec, params = model
+    reqs = _requests(dec.cfg.vocab_size)
+    outs_l, st_l = serve_disagg(
+        dec, params, reqs, num_blocks=16, block_size=4, max_batch=2,
+        compress=False,
+    )
+    outs_q, st_q = serve_disagg(
+        dec, params, reqs, num_blocks=16, block_size=4, max_batch=2,
+        compress=False, quantize="int8",
+    )
+    for (prompt, steps), got in zip(reqs, outs_q):
+        assert np.asarray(got).shape == (1, prompt.shape[1] + steps)
+    assert st_q["quantize"] == "int8"
+    # int8 KV frames ~1/4 of float32; the stream total (meta blobs +
+    # fp32 logits rows ride along) must still shrink decisively.
+    assert st_q["kv_bytes_recv"] < 0.6 * st_l["kv_bytes_recv"]
+
+
+# -- failure handling ------------------------------------------------------
+
+
+def test_worker_drop_mid_stream_retries(model):
+    """First worker dies after one payload without a STOP; the
+    orchestrator must re-dispatch the undelivered tail to a fresh
+    worker and produce token-identical outputs."""
+    dec, params = model
+    reqs = _requests(dec.cfg.vocab_size)
+    mono, _ = serve_paged(
+        dec, params, reqs, num_blocks=16, block_size=4, max_batch=2
+    )
+    spawned = []
+
+    def spawn():
+        ports: "queue_mod.Queue[int]" = queue_mod.Queue()
+        fail = 1 if not spawned else None
+        t = threading.Thread(
+            target=serve_prefill,
+            kwargs=dict(
+                listen_port=0, announce=ports.put,
+                fail_after_requests=fail,
+            ),
+            daemon=True,
+        )
+        t.start()
+        spawned.append(t)
+        return "127.0.0.1", ports.get(timeout=30)
+
+    outs, stats = serve_disagg(
+        dec, params, reqs, num_blocks=16, block_size=4, max_batch=2,
+        spawn_worker=spawn, worker_retries=2,
+    )
+    for i, (a, b) in enumerate(zip(mono, outs)):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=f"request {i}"
+        )
+    assert stats["worker_restarts"] == 1
+    assert len(spawned) == 2
+
+
+def test_worker_drop_exhausts_retries(model):
+    """Every worker dies: after worker_retries replacements the error
+    surfaces instead of looping forever."""
+    dec, params = model
+    reqs = _requests(dec.cfg.vocab_size)[:2]
+
+    def spawn():
+        ports: "queue_mod.Queue[int]" = queue_mod.Queue()
+        t = threading.Thread(
+            target=serve_prefill,
+            kwargs=dict(
+                listen_port=0, announce=ports.put,
+                fail_after_requests=1,
+            ),
+            daemon=True,
+        )
+        t.start()
+        return "127.0.0.1", ports.get(timeout=30)
+
+    with pytest.raises(TransportError, match="restart"):
+        serve_disagg(
+            dec, params, reqs, num_blocks=16, block_size=4,
+            max_batch=2, spawn_worker=spawn, worker_retries=1,
+        )
+
+
+def test_deliver_kv_rejects_geometry_skew(model):
+    """A payload whose block geometry disagrees with the server is a
+    config skew, refused loudly before it can corrupt the pool."""
+    dec, params = model
+    srv = PagedDecodeServer(
+        dec, params, num_blocks=8, block_size=4, max_batch=2
+    )
+    rid = srv.submit_prefilled(
+        jnp.asarray([[1, 2, 3]], jnp.int32), 4
+    )
+    L, hkv = dec.cfg.num_layers, dec.cfg.kv_heads
+    dh = dec.cfg.dim // dec.cfg.num_heads
+    good_k = np.zeros((L, 1, hkv, 4, dh), np.float32)
+    with pytest.raises(ValueError, match="shape"):
+        srv.deliver_kv(
+            rid, good_k[:, :, :, :2, :], good_k[:, :, :, :2, :],
+            np.zeros((1, dec.cfg.vocab_size), np.float32),
+        )
+    with pytest.raises(ValueError, match="first_logits"):
+        srv.deliver_kv(
+            rid, good_k, good_k, np.zeros((1, 3), np.float32)
+        )
+    with pytest.raises(KeyError):
+        srv.deliver_kv(
+            999, good_k, good_k,
+            np.zeros((1, dec.cfg.vocab_size), np.float32),
+        )
+
+
+def test_submit_prefilled_rejects_unsupported_modes(model):
+    dec, params = model
+    srv = PagedDecodeServer(
+        dec, params, num_blocks=8, block_size=4, max_batch=2,
+        prefix_ids=jnp.asarray([[1, 2, 3, 4]], jnp.int32),
+    )
+    with pytest.raises(ValueError, match="prefix_cache"):
+        srv.submit_prefilled(jnp.asarray([[1, 2]], jnp.int32), 2)
+
+
+# -- cross-host prefix sharing ---------------------------------------------
+
+
+def test_ingested_blocks_revive_through_prefix_cache(model):
+    """Blocks prefilled on the WORKER must park in the decode host's
+    radix cache at finish, so a later LOCAL request with the same
+    prefix skips its prefill — cross-host prefix sharing, the
+    parking/revival acceptance criterion."""
+    dec, params = model
+    reqs = _requests(dec.cfg.vocab_size)[:1]
+    mono, _ = serve_paged(
+        dec, params, reqs, num_blocks=24, block_size=4, max_batch=2
+    )
+    srv = PagedDecodeServer(
+        dec, params, num_blocks=24, block_size=4, max_batch=2,
+        prefix_cache=True,
+    )
+    outs, _ = serve_disagg(
+        dec, params, reqs, num_blocks=24, block_size=4, max_batch=2,
+        server=srv,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(outs[0]), np.asarray(mono[0])
+    )
+    # the 8-token prompt's two full blocks are parked, not freed
+    assert srv.radix.cached_blocks >= 2
+    assert srv.prefill_tokens_saved == 0
+    rid = srv.submit(reqs[0][0], reqs[0][1])
+    out2 = srv.run()[rid]
+    np.testing.assert_array_equal(
+        np.asarray(out2), np.asarray(mono[0])
+    )
+    # the local admission walked onto the ingested blocks
+    assert srv.prefill_tokens_saved > 0
+
+
+# -- ingest drain unit behavior --------------------------------------------
+
+
+def test_ingest_clean_eof_sets_flag(model):
+    dec, params = model
+    srv = PagedDecodeServer(
+        dec, params, num_blocks=8, block_size=4, max_batch=2
+    )
+    recv = ArrayReceiver(0, host="127.0.0.1", accept_timeout_s=10.0)
+    ingest = KVBlockIngest(srv, recv)
+    ingest.start()
+    send = ArraySender("127.0.0.1", recv.port)
+    send.close()  # STOP with no payloads
+    assert ingest.eof.wait(timeout=10)
+    assert not ingest.failed.is_set()
+    assert ingest.pump() == 0
+    ingest.close()
+    recv.close()

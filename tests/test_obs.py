@@ -418,3 +418,43 @@ def test_codec_byte_counters_and_q8_no_double_count():
     np.testing.assert_allclose(
         codec.decode(f2), a, atol=2.0 / 127.0
     )
+
+
+def test_disagg_metrics_names_and_serving_integration():
+    """DisaggMetrics registers the disagg instrument family under the
+    role label, serve_disagg drives them, and every name passes the
+    obs-name-drift conventions (counters end _total, etc. — the
+    analysis lint pins the same rules statically)."""
+    from defer_tpu.obs import DisaggMetrics
+    from defer_tpu.disagg import serve_disagg
+
+    obs_reset()
+    m = DisaggMetrics("prefill")
+    snap = m.registry.to_dict()
+    flat = {**snap["counters"], **snap["histograms"]}
+    for name in (
+        'defer_kv_blocks_shipped_total{role="prefill"}',
+        'defer_kv_block_bytes_sent_total{role="prefill"}',
+        'defer_kv_block_bytes_recv_total{role="prefill"}',
+        'defer_kv_ingest_wait_seconds{role="prefill"}',
+        'defer_disagg_worker_restarts_total{role="prefill"}',
+    ):
+        assert name in flat, name
+
+    dec = tiny_gpt(64)
+    params = dec.init(jax.random.key(0))
+    reqs = [(jnp.asarray([[3, 9, 27, 1, 4]], jnp.int32), 4)]
+    _, stats = serve_disagg(
+        dec, params, reqs, num_blocks=8, block_size=4, max_batch=2
+    )
+    reg = m.registry
+    shipped = reg.value(
+        "defer_kv_blocks_shipped_total", role="prefill"
+    )
+    assert shipped == 2  # ceil(5 / 4) blocks for the one request
+    sent = reg.value("defer_kv_block_bytes_sent_total", role="prefill")
+    recvd = reg.value("defer_kv_block_bytes_recv_total", role="decode")
+    assert sent == recvd == stats["kv_bytes_recv"] > 0
+    # the payload waited in the ingest queue at least once
+    hist = reg.value("defer_kv_ingest_wait_seconds", role="decode")
+    assert hist["count"] == 1

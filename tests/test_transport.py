@@ -116,3 +116,49 @@ def test_initialize_single_process_noop():
     topo = initialize()
     assert topo["process_count"] == 1
     assert topo["global_devices"] >= 1
+
+
+def test_receiver_read_timeout_surfaces_stalled_peer():
+    """A peer that connects and then goes silent must surface as a
+    TransportError after read_timeout_s, not block forever."""
+    recv = ArrayReceiver(
+        0, host="127.0.0.1", accept_timeout_s=5.0, read_timeout_s=0.2
+    )
+    send = ArraySender("127.0.0.1", recv.port)
+    # send nothing: the receiver accepts, then stalls on the first
+    # header read until the timeout trips
+    with pytest.raises(TransportError, match="timed out"):
+        next(iter(recv))
+    send.close()
+    recv.close()
+
+
+def test_sender_backoff_validation():
+    with pytest.raises(ValueError, match="backoff"):
+        ArraySender("127.0.0.1", 1, backoff_base_s=-0.1)
+    with pytest.raises(ValueError, match="backoff"):
+        ArraySender("127.0.0.1", 1, backoff_base_s=1.0, backoff_cap_s=0.5)
+
+
+def test_wire_byte_accounting_sender_receiver_agree():
+    """send() returns the frame's wire bytes and the receiver's
+    rx_frame_bytes counts the same total — the per-stream accounting
+    the disagg byte counters are built on."""
+    send, recv = _loopback_pair()
+    arrays = [
+        np.arange(24, dtype=np.float32).reshape(4, 6),
+        np.zeros((0, 3), np.int32),
+    ]
+    got = []
+
+    def consume():
+        got.extend(recv)
+
+    t = threading.Thread(target=consume)
+    t.start()
+    sent = sum(send.send(a) for a in arrays)
+    send.close()
+    t.join(timeout=10)
+    assert len(got) == len(arrays)
+    assert sent == recv.rx_frame_bytes > 0
+    recv.close()
