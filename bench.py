@@ -587,6 +587,29 @@ def bench_disagg(devices) -> dict:
     return rec
 
 
+def bench_fleet(devices) -> dict:
+    """Fleet serving (scripts/bench_fleet.py): a bursty, prefix-shared
+    request mix over N replica paged servers under prefix-aware vs
+    round-robin routing, plus an overload flood against a tight SLO.
+    Headlines: the radix hit-rate gap between the two policies (the
+    value of routing on cache locality) and shed rate with bounded
+    queue-wait p99 under overload (graceful degradation)."""
+    import importlib.util
+    import os
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "scripts",
+        "bench_fleet.py",
+    )
+    spec = importlib.util.spec_from_file_location("bench_fleet", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rec = mod.run_microbench(devices)
+    log(f"fleet serving: {rec}")
+    return rec
+
+
 def bench_bert(devices) -> dict:
     """Single-chip SPMD BERT-base forward throughput + MFU."""
     import jax
@@ -965,6 +988,7 @@ def run_bench() -> dict:
             ("paged_attention", bench_paged_attention),
             ("decode_window", bench_decode_window),
             ("disagg", bench_disagg),
+            ("fleet", bench_fleet),
             ("bert_base", bench_bert),
         ]
         # Mosaic-kernel section last. It runs wherever the pallas gate

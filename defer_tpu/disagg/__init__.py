@@ -25,12 +25,13 @@ from defer_tpu.disagg.prefill_worker import (
     run_prefill,
     serve_prefill,
 )
-from defer_tpu.disagg.wire import KVPayload, WIRE_VERSION
+from defer_tpu.disagg.wire import KVPayload, PrefixPayload, WIRE_VERSION
 
 __all__ = [
     "IngestError",
     "KVBlockIngest",
     "KVPayload",
+    "PrefixPayload",
     "WIRE_VERSION",
     "prefill_schedule",
     "run_prefill",

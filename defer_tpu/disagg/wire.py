@@ -373,6 +373,105 @@ def send_kv_payload(
     return n
 
 
+@dataclasses.dataclass
+class PrefixPayload:
+    """A root-anchored radix prefix chain lifted out of one replica's
+    pool for fleet migration: per-block OWN-token bytes (int64, the
+    radix cache's tok_of encoding) plus [layers, n, kv_heads,
+    block_size, head_dim] K/V block stacks. Deliberately carries token
+    bytes and NOT digests — the importer recomputes the chained keys
+    itself (runtime/paged.py::import_prefix_blocks), so a corrupted or
+    hostile payload mis-keys into digests nothing looks up instead of
+    aliasing a resident chain."""
+
+    toks: list[bytes]
+    k: np.ndarray
+    v: np.ndarray
+    wire_bytes: int = 0
+
+
+def send_prefix_payload(
+    sender: ArraySender, payload: PrefixPayload
+) -> int:
+    """Frame one prefix chain onto a stream. Pinned LOSSLESS end to
+    end, unlike per-request KV transfer: a migrated block becomes
+    long-lived shared cache state on the importer, so a lossy copy
+    would skew every future sharer — not one opted-in request.
+    Returns wire bytes sent."""
+    L, n_blocks, hkv, bs, dh = payload.k.shape
+    if len(payload.toks) != n_blocks:
+        raise ValueError(
+            f"{len(payload.toks)} token blobs for {n_blocks} blocks"
+        )
+    k_w, token = to_wire_array(payload.k)
+    v_w, _ = to_wire_array(payload.v)
+    n = send_blob(
+        sender,
+        {
+            "kind": "prefix",
+            "version": WIRE_VERSION,
+            "n_blocks": n_blocks,
+            "layers": L,
+            "block_size": bs,
+            "kv_heads": hkv,
+            "head_dim": dh,
+            "dtype": token,
+            "toks": [t.hex() for t in payload.toks],
+        },
+    )
+    saved = sender.quantize
+    sender.quantize = None
+    try:
+        for layer in range(L):
+            n += sender.send(k_w[layer])
+            n += sender.send(v_w[layer])
+    finally:
+        sender.quantize = saved
+    return n
+
+
+def read_prefix_payload(
+    it: Iterator[np.ndarray], receiver: ArrayReceiver | None = None
+) -> PrefixPayload | None:
+    """Next prefix chain off a stream (None at a clean end). Pass the
+    receiver to account wire bytes on the payload."""
+    start = receiver.rx_frame_bytes if receiver is not None else 0
+    meta = read_blob(it)
+    if meta is None:
+        return None
+    if meta.get("kind") != "prefix":
+        raise TransportError(
+            f"expected 'prefix' blob, got {meta.get('kind')!r}"
+        )
+    if meta.get("version") != WIRE_VERSION:
+        raise TransportError(
+            f"wire version {meta.get('version')} != {WIRE_VERSION}"
+        )
+    L = meta["layers"]
+    token = meta["dtype"]
+    ks, vs = [], []
+    for layer in range(L):
+        ks.append(
+            from_wire_array(
+                _next_frame(it, f"layer {layer} prefix K frame"), token
+            )
+        )
+        vs.append(
+            from_wire_array(
+                _next_frame(it, f"layer {layer} prefix V frame"), token
+            )
+        )
+    nbytes = (
+        receiver.rx_frame_bytes - start if receiver is not None else 0
+    )
+    return PrefixPayload(
+        toks=[bytes.fromhex(t) for t in meta["toks"]],
+        k=np.stack(ks),
+        v=np.stack(vs),
+        wire_bytes=nbytes,
+    )
+
+
 def iter_kv_payloads(
     receiver: ArrayReceiver, obs: Any = None
 ) -> Iterator[KVPayload]:

@@ -1,0 +1,374 @@
+"""`serve_fleet()`: N paged decode replicas behind one front door.
+
+The front-end owns the three cooperating pieces: the router
+(fleet/router.py — prefix-aware placement over replica digest
+advertisements), the admission controller (fleet/admission.py —
+bounded queues + SLO shedding), and the replicas (fleet/replica.py —
+one `PagedDecodeServer` per serving thread). `serve_fleet` keeps the
+`serve_paged` contract — (outputs in submission order, stats) — and at
+`n_replicas=1` with default knobs is token-identical to it: one
+replica, nothing to route, unbounded queue, no SLO, so every request
+takes the same `submit -> admit -> tick` path on the same server
+class.
+
+Replica placement defaults to in-process threads; pass
+`spawn_replica(idx, make_server, controller, board, obs, *, on_done,
+on_fail, on_dead)` returning a ThreadReplica-shaped object to place
+replicas elsewhere (the `spawn_worker=` pattern from disagg/api.py).
+
+Failure semantics: a dead replica fails its in-flight requests with
+`ReplicaDeadError` (their KV died with the pool — silently re-running
+them would hide a real outage), re-routes its still-queued requests to
+surviving replicas, and drops out of the routing set. Shedding raises
+`ShedError` from `submit()` — admission rejections are synchronous and
+typed, never a hang.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any
+
+import jax
+
+from defer_tpu.disagg.wire import PrefixPayload
+from defer_tpu.fleet.admission import AdmissionController, ShedError
+from defer_tpu.fleet.replica import ReplicaDeadError, ThreadReplica
+from defer_tpu.fleet.router import AdvertisementBoard, PrefixRouter
+from defer_tpu.obs.serving import FleetMetrics, FleetStats, ServerStats
+from defer_tpu.runtime.paged import PagedDecodeServer
+from defer_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class _FleetRequest:
+    gid: int
+    prompt: Any
+    steps: int
+    sampling: Any = None
+    stop: Any = None
+
+
+class FleetFrontend:
+    """Construct replicas, route, admit, await. One instance per
+    serving session; `close()` stops the replica threads."""
+
+    def __init__(
+        self,
+        dec: Any,
+        params: dict,
+        *,
+        n_replicas: int = 1,
+        num_blocks: int,
+        block_size: int = 16,
+        max_batch: int = 4,
+        eos_id: int | None = None,
+        prefix_cache: bool = False,
+        attention: str = "gathered",
+        decode_window: int = 1,
+        policy: str = "prefix",
+        slo_s: float | None = None,
+        max_queue: int = 0,
+        enqueue_wait_s: float = 0.05,
+        migrate: bool = True,
+        migrate_gap: int = 4,
+        spawn_replica: Any = None,
+    ):
+        if n_replicas < 1:
+            raise ValueError(f"need >= 1 replica, got {n_replicas}")
+        self.n_replicas = n_replicas
+        self.block_size = block_size
+        self.policy = policy
+        self.obs = FleetMetrics(n_replicas)
+        # The obs registry is process-global and instruments are
+        # shared by (name, labels): zero the per-replica gauges up
+        # front so a previous fleet's parting values can't steer this
+        # run's first routing decisions.
+        for i in range(n_replicas):
+            self.obs.queue_depth[i].set(0)
+            self.obs.inflight[i].set(0)
+            self.obs.pool_free[i].set(0)
+        self.controller = AdmissionController(
+            n_replicas,
+            self.obs,
+            max_queue=max_queue,
+            slo_s=slo_s,
+            enqueue_wait_s=enqueue_wait_s,
+        )
+        self.board = AdvertisementBoard(n_replicas)
+        self.router = PrefixRouter(
+            self.board,
+            self.obs,
+            policy=policy,
+            migrate=migrate,
+            migrate_gap=migrate_gap,
+        )
+        self.alive = [True] * n_replicas
+
+        def make_server() -> PagedDecodeServer:
+            return PagedDecodeServer(
+                dec,
+                params,
+                num_blocks=num_blocks,
+                block_size=block_size,
+                max_batch=max_batch,
+                eos_id=eos_id,
+                prefix_cache=prefix_cache,
+                attention=attention,
+                decode_window=decode_window,
+            )
+
+        spawn = spawn_replica or ThreadReplica
+        self.replicas = [
+            spawn(
+                i,
+                make_server,
+                self.controller,
+                self.board,
+                self.obs,
+                on_done=self._complete,
+                on_fail=self._fail,
+                on_dead=self._on_dead,
+            )
+            for i in range(n_replicas)
+        ]
+        self._lock = threading.RLock()
+        self._results: dict[int, dict] = {}
+        self._next_gid = 0
+        self.routed = {r: 0 for r in FleetMetrics.ROUTE_REASONS}
+        self.shed = {r: 0 for r in FleetMetrics.SHED_REASONS}
+        self.migrated_blocks = 0
+        for r in self.replicas:
+            r.start()
+
+    # -- result plumbing (called from replica threads) ---------------------
+
+    def _complete(self, gid: int, tokens: Any) -> None:
+        slot = self._results.get(gid)
+        if slot is None:
+            return
+        slot["val"] = tokens
+        slot["event"].set()
+
+    def _fail(self, gid: int, exc: BaseException) -> None:
+        slot = self._results.get(gid)
+        if slot is None:
+            return
+        slot["exc"] = exc
+        slot["event"].set()
+
+    def _on_dead(self, idx: int, exc: BaseException) -> None:
+        """Replica-death protocol: drop it from routing, then re-route
+        everything still parked in its admission queue (never touched
+        by the dead server). Runs on the dying replica's thread."""
+        log.warning("fleet replica %d died: %s", idx, exc)
+        with self._lock:
+            self.alive[idx] = False
+            queued = self.controller.drain(idx)
+        for req in queued:
+            try:
+                self._route_and_admit(req)
+            except (ShedError, RuntimeError, ReplicaDeadError) as e:
+                self._fail(req.gid, e)
+
+    # -- routing -----------------------------------------------------------
+
+    def _do_migrate(self, decision) -> bool:
+        """Ship the decided prefix chain source -> target as a
+        disagg/wire PrefixPayload (the importer recomputes the chained
+        digests from the payload's token bytes). Both ends run on
+        their own serving threads via replica ops. False = anything
+        went stale or broke; the caller downgrades to fallback."""
+        src = self.replicas[decision.source]
+        dst = self.replicas[decision.replica]
+        keys = decision.keys
+        try:
+            exported = src.call(
+                lambda srv: srv.export_prefix_blocks(keys)
+            )
+            if exported is None:
+                return False  # evicted since the advertisement
+            toks, k, v = exported
+            payload = PrefixPayload(toks=toks, k=k, v=v)
+            n = dst.call(
+                lambda srv: srv.import_prefix_blocks(
+                    payload.toks, payload.k, payload.v
+                )
+            )
+        except (ReplicaDeadError, TimeoutError) as e:
+            log.warning("prefix migration failed: %s", e)
+            return False
+        if n:
+            self.obs.migrated_blocks.inc(n)
+            self.migrated_blocks += n
+        return True
+
+    def _route_and_admit(self, req: _FleetRequest) -> None:
+        with self._lock:
+            t0 = int(req.prompt.shape[1])
+            decision = self.router.route(
+                req.prompt,
+                t0 // self.block_size,
+                self.block_size,
+                self.alive,
+            )
+            if decision.reason == "migrate":
+                if not self._do_migrate(decision):
+                    decision.reason = "fallback"
+            self.obs.routed[decision.reason].inc()
+            self.routed[decision.reason] += 1
+            try:
+                self.controller.admit(decision.replica, req)
+            except ShedError as e:
+                self.shed[e.reason] = self.shed.get(e.reason, 0) + 1
+                raise
+
+    # -- public API --------------------------------------------------------
+
+    def submit(
+        self,
+        prompt_ids: Any,
+        num_steps: int,
+        *,
+        sampling: Any = None,
+        stop: Any = None,
+    ) -> int:
+        """Route + enqueue one request; returns a fleet-wide id for
+        `result()`. Raises ShedError synchronously when admission
+        rejects it (the future is cleaned up — a shed request can
+        never be waited on into a hang)."""
+        if prompt_ids.ndim != 2 or prompt_ids.shape[0] != 1:
+            raise ValueError("submit one request at a time ([1, T])")
+        with self._lock:
+            gid = self._next_gid
+            self._next_gid += 1
+        self._results[gid] = {"event": threading.Event()}
+        req = _FleetRequest(gid, prompt_ids, num_steps, sampling, stop)
+        try:
+            self._route_and_admit(req)
+        except ShedError:
+            del self._results[gid]
+            raise
+        return gid
+
+    def result(self, gid: int, timeout: float | None = None) -> Any:
+        """Block until request `gid` finishes; returns its [1, T]
+        token array or raises the request's typed failure
+        (ReplicaDeadError et al)."""
+        slot = self._results.get(gid)
+        if slot is None:
+            raise KeyError(f"unknown or shed request {gid}")
+        if not slot["event"].wait(timeout):
+            raise TimeoutError(f"request {gid} not done in {timeout}s")
+        del self._results[gid]
+        if "exc" in slot:
+            raise slot["exc"]
+        return slot["val"]
+
+    def close(self) -> None:
+        for r in self.replicas:
+            r.close()
+
+    def stats(self) -> FleetStats:
+        """Fleet-level snapshot plus per-replica ServerStats (the same
+        fields `serve_paged` reports), replica-index order; dead
+        replicas report `dead` with the cause."""
+        per = []
+        for r in self.replicas:
+            srv = r.srv
+            per.append(
+                ServerStats.snapshot(
+                    srv.obs.registry,
+                    ticks=srv.ticks,
+                    attention=srv.attention,
+                    peak_blocks=srv.blocks_peak,
+                    pool_blocks=int(srv.pool_k.shape[1]) - 1,
+                    block_size=srv.bs,
+                    decode_window=srv.decode_window,
+                    host_dispatches=srv.dispatches,
+                    cached_blocks=(
+                        srv.radix.cached_blocks
+                        if srv.radix is not None
+                        else 0
+                    ),
+                    prefill_tokens_saved=srv.prefill_tokens_saved,
+                    dead=str(r.dead) if r.dead is not None else None,
+                )
+            )
+        return FleetStats.snapshot(
+            self.obs.registry,
+            n_replicas=self.n_replicas,
+            policy=self.policy,
+            routed=dict(self.routed),
+            shed=dict(self.shed),
+            migrated_blocks=self.migrated_blocks,
+            replicas=per,
+        )
+
+
+def serve_fleet(
+    dec: Any,
+    params: dict,
+    requests: list[tuple[jax.Array, int]],
+    *,
+    n_replicas: int = 1,
+    num_blocks: int,
+    block_size: int = 16,
+    max_batch: int = 4,
+    eos_id: int | None = None,
+    prefix_cache: bool = False,
+    attention: str = "gathered",
+    decode_window: int = 1,
+    sampling: list | None = None,
+    stop: list | None = None,
+    policy: str = "prefix",
+    slo_s: float | None = None,
+    max_queue: int = 0,
+    migrate: bool = True,
+    migrate_gap: int = 4,
+    spawn_replica: Any = None,
+    result_timeout_s: float = 600.0,
+) -> tuple[list[jax.Array], dict]:
+    """One-shot fleet serving; same contract as `serve_paged` (outputs
+    in submission order + stats) over `n_replicas` paged servers, each
+    sized `num_blocks`/`max_batch` on its own. Default knobs shed
+    nothing (unbounded queues, no SLO) — overload policy is opt-in via
+    `slo_s`/`max_queue`, and a ShedError then propagates to the
+    caller. Returns FleetStats: routing-reason and shed counts,
+    migrated block totals, and per-replica ServerStats."""
+    fe = FleetFrontend(
+        dec,
+        params,
+        n_replicas=n_replicas,
+        num_blocks=num_blocks,
+        block_size=block_size,
+        max_batch=max_batch,
+        eos_id=eos_id,
+        prefix_cache=prefix_cache,
+        attention=attention,
+        decode_window=decode_window,
+        policy=policy,
+        slo_s=slo_s,
+        max_queue=max_queue,
+        migrate=migrate,
+        migrate_gap=migrate_gap,
+        spawn_replica=spawn_replica,
+    )
+    samps = sampling or [None] * len(requests)
+    stops = stop or [None] * len(requests)
+    if len(samps) != len(requests) or len(stops) != len(requests):
+        raise ValueError(
+            "sampling/stop must have one entry per request when given"
+        )
+    try:
+        gids = [
+            fe.submit(p, s, sampling=sp, stop=st)
+            for (p, s), sp, st in zip(requests, samps, stops)
+        ]
+        outs = [fe.result(g, timeout=result_timeout_s) for g in gids]
+    finally:
+        fe.close()
+    return outs, fe.stats()

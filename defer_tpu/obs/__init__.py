@@ -17,11 +17,19 @@ from defer_tpu.obs.metrics import (
     reset,
 )
 from defer_tpu.obs.export import PeriodicDumper, prometheus_text
-from defer_tpu.obs.serving import DisaggMetrics, ServerStats, ServingMetrics
+from defer_tpu.obs.serving import (
+    DisaggMetrics,
+    FleetMetrics,
+    FleetStats,
+    ServerStats,
+    ServingMetrics,
+)
 
 __all__ = [
     "Counter",
     "DisaggMetrics",
+    "FleetMetrics",
+    "FleetStats",
     "Gauge",
     "Histogram",
     "MetricsRegistry",

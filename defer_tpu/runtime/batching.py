@@ -30,6 +30,34 @@ from defer_tpu.runtime.host_io import STOP
 _ROW_BUCKETS = tuple(float(1 << i) for i in range(11))
 
 
+class Deadline:
+    """Monotonic SLO deadline: one start-time capture plus
+    remaining-budget arithmetic, shared by every wait loop that blocks
+    "at most X seconds after the first event" (the batch gatherer's
+    flush SLO here, the fleet admission queues in
+    fleet/admission.py). Centralizing it keeps the `time.monotonic`
+    bookkeeping in one place — a wait loop that recomputes its own
+    deadline from `time.time` or re-anchors per iteration silently
+    stretches the SLO."""
+
+    __slots__ = ("t0", "at")
+
+    def __init__(self, budget_s: float):
+        self.t0 = time.monotonic()
+        self.at = self.t0 + budget_s
+
+    def remaining(self) -> float:
+        """Seconds of budget left (negative once expired)."""
+        return self.at - time.monotonic()
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0
+
+    def elapsed(self) -> float:
+        """Seconds since the deadline was armed."""
+        return time.monotonic() - self.t0
+
+
 class BatchGatherer:
     """Coalesce queue items (arrays with a leading batch dim) into one
     stacked batch per dispatch.
@@ -124,11 +152,10 @@ class BatchGatherer:
         # the device batch never exceeds batch_size (unless a single
         # item is itself larger — items are atomic).
         total = int(items[0].shape[0])
-        t_first = time.monotonic()
-        deadline = t_first + self.max_wait_s
+        dl = Deadline(self.max_wait_s)
         reason = "full"  # loop exits via its condition when filled
         while total < self.batch_size:
-            remaining = deadline - time.monotonic()
+            remaining = dl.remaining()
             if remaining <= 0:
                 reason = "timeout"
                 break
@@ -152,7 +179,7 @@ class BatchGatherer:
             items.append(nxt)
             total += int(nxt.shape[0])
         self._obs_rows.observe(float(total))
-        self._obs_wait.observe(time.monotonic() - t_first)
+        self._obs_wait.observe(dl.elapsed())
         self._obs_flush[reason].inc()
         sizes = [int(x.shape[0]) for x in items]
         pad = 0

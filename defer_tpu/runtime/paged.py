@@ -71,6 +71,7 @@ allocated blocks in one jitted op.
 from __future__ import annotations
 
 import hashlib
+import threading
 import time
 from typing import Any
 
@@ -184,6 +185,16 @@ class PrefixBlockCache:
         self.tok_of: dict[int, bytes] = {}  # own-block tokens (guard)
         self.lru: dict[int, None] = {}  # refcount-0 blocks, dict=LRU
         self._obs = obs
+        # Advertisement seam (fleet routing): `generation` bumps on
+        # every change to the RESIDENT KEY SET (register / evict /
+        # displacement), never on refcount churn, so a router can
+        # compare one int to skip unchanged snapshots. The lock covers
+        # only key-set mutation and snapshotting — the owning serving
+        # thread is the sole mutator, the router's snapshot reader the
+        # sole other party — so hot-path walk()/release() stay
+        # lock-free.
+        self.generation = 0
+        self._lock = threading.Lock()
 
     @staticmethod
     def _hash(prev_key: bytes, block_bytes: bytes) -> bytes:
@@ -257,7 +268,9 @@ class PrefixBlockCache:
             del self.ref[displaced]
             del self.key_of[displaced]
             del self.tok_of[displaced]
-        self.by_key[key] = blk
+        with self._lock:
+            self.by_key[key] = blk
+            self.generation += 1
         self.ref[blk] = 1
         self.key_of[blk] = key
         self.tok_of[blk] = block_bytes
@@ -279,13 +292,26 @@ class PrefixBlockCache:
         while self.lru and len(out) < n:
             blk = next(iter(self.lru))
             del self.lru[blk]
-            del self.by_key[self.key_of.pop(blk)]
+            with self._lock:
+                del self.by_key[self.key_of.pop(blk)]
+                self.generation += 1
             del self.ref[blk]
             del self.tok_of[blk]
             out.append(blk)
         if out and self._obs is not None:
             self._obs.prefix_evictions.inc(len(out))
         return out
+
+    def resident_digests(self) -> tuple[int, frozenset[bytes]]:
+        """(generation, resident chained digests) — the routing
+        advertisement. A SHALLOW snapshot: the frozenset copies only
+        key references (16-byte digests already interned in by_key),
+        never block payloads or token bytes, so a router can poll this
+        from another thread at advertisement frequency without taxing
+        admission. The generation lets callers drop unchanged
+        snapshots with one int compare before building anything."""
+        with self._lock:
+            return self.generation, frozenset(self.by_key)
 
     @property
     def cached_blocks(self) -> int:
@@ -435,6 +461,7 @@ class PagedDecodeServer:
         self._step = None
         self._insert = None
         self._insert_dyn = None
+        self._import = None
         self.prefix_len = 0
         self.shared_blocks: list[int] = []
         self._prefix_cache = None
@@ -716,6 +743,158 @@ class PagedDecodeServer:
                 - len(self.radix.lru)
             )
         return sum(len(s["blocks"]) for s in self.slots if s)
+
+    def resident_digests(self) -> tuple[int, frozenset[bytes]]:
+        """Routing advertisement passthrough (PrefixBlockCache
+        docstring); (0, empty) without prefix_cache=True so fleet
+        callers need no radix check."""
+        if self.radix is None:
+            return 0, frozenset()
+        return self.radix.resident_digests()
+
+    def export_prefix_blocks(
+        self, keys: list[bytes]
+    ) -> tuple[list[bytes], np.ndarray, np.ndarray] | None:
+        """Copy a resident prefix chain OUT of the pool for migration:
+        `keys` is a root-anchored run of chained digests (the router's
+        walk order); returns (own-block token bytes per block,
+        [L, n, Hkv, bs, Dh] K and V block stacks) or None if any key
+        was evicted since the advertisement the caller routed on.
+
+        SERVING-THREAD ONLY: the decode step donates the pool buffers,
+        so a reader on any other thread can observe an invalidated
+        buffer mid-tick. Fleet replicas run this as an ops-queue
+        command between ticks. The copy is host-side and
+        self-contained — once returned, eviction on this replica
+        cannot hurt the importer."""
+        if self.radix is None:
+            raise ValueError("export needs prefix_cache=True")
+        blks: list[int] = []
+        toks: list[bytes] = []
+        for key in keys:
+            blk = self.radix.by_key.get(key)
+            if blk is None:
+                return None  # evicted since the advert; stale route
+            blks.append(blk)
+            toks.append(self.radix.tok_of[blk])
+        # analysis: ignore[host-sync-in-hot-loop] host-side block-id
+        # list becoming device gather indices — no device readback
+        idx = jnp.asarray(np.asarray(blks, np.int32))
+        # analysis: ignore[host-sync-in-hot-loop] deliberate sync — a
+        # migration ships the payload over a host wire, so the copy to
+        # host memory IS the operation
+        k = np.asarray(self.pool_k[:, idx])
+        # analysis: ignore[host-sync-in-hot-loop] second half of the
+        # same deliberate migration copy
+        v = np.asarray(self.pool_v[:, idx])
+        return toks, k, v
+
+    def _ensure_import(self):
+        if self._import is None:
+            from defer_tpu.utils.memo import cached_step
+
+            def build():
+                def imp(pk, pv, k_blocks, v_blocks, dest):
+                    # Pad entries in dest are 0: duplicate writes to
+                    # trash block 0 race over garbage, by the module
+                    # invariant.
+                    pk = pk.at[:, dest].set(k_blocks)
+                    pv = pv.at[:, dest].set(v_blocks)
+                    return pk, pv
+
+                return jax.jit(imp, donate_argnums=(0, 1))
+
+            self._import = cached_step(
+                self.dec, ("fleet_import", self.bs), build
+            )
+        return self._import
+
+    def import_prefix_blocks(
+        self,
+        toks: list[bytes],
+        k_blocks: np.ndarray,
+        v_blocks: np.ndarray,
+    ) -> int:
+        """Seat a migrated prefix chain (export_prefix_blocks payload)
+        in this pool as PARKED radix entries — the next admission
+        sharing the prefix revives them through the normal walk, no
+        re-prefill. Chained digests are recomputed HERE from the token
+        bytes (never trusted from the wire), so a corrupted payload
+        mis-keys into digests nothing will ever look up, not into
+        another chain. Already-resident leading blocks are skipped;
+        allocation evicts parked LRU blocks under pressure and
+        truncates the (deep) tail when the pool still can't cover it —
+        the shallow end is the reusable end. Returns blocks imported.
+
+        SERVING-THREAD ONLY, same donation rule as export."""
+        if self.radix is None:
+            raise ValueError("import needs prefix_cache=True")
+        n = len(toks)
+        cfg = self.dec.cfg
+        expect = (
+            cfg.num_layers, n, cfg.kv_heads, self.bs,
+            cfg.dim // cfg.num_heads,
+        )
+        if tuple(k_blocks.shape) != expect or tuple(v_blocks.shape) != expect:
+            raise ValueError(
+                f"prefix block stack shape {tuple(k_blocks.shape)}/"
+                f"{tuple(v_blocks.shape)} != expected {expect}"
+            )
+        keys: list[bytes] = []
+        prev = b""
+        for bb in toks:
+            prev = PrefixBlockCache._hash(prev, bb)
+            keys.append(prev)
+        # Skip the already-resident leading run (tok-guarded, same
+        # collision discipline as walk()).
+        m = 0
+        while m < n:
+            blk = self.radix.by_key.get(keys[m])
+            if blk is None or self.radix.tok_of[blk] != toks[m]:
+                break
+            m += 1
+        if m == n:
+            return 0
+        need = n - m
+        if need > len(self.free):
+            self.free.extend(self.radix.evict(need - len(self.free)))
+        take = min(need, len(self.free))
+        if take == 0:
+            return 0
+        own = [self.free.pop() for _ in range(take)]
+        # Pow2-pad the imported span (capped at MB) so migration draws
+        # from the same bounded compile-shape set as prefill; pad dest
+        # entries point at trash block 0.
+        n_pad = 1 << max(take - 1, 0).bit_length()
+        n_pad = min(max(n_pad, 1), self.MB)
+        dest = np.zeros((n_pad,), np.int32)
+        dest[:take] = own
+        kb = np.ascontiguousarray(k_blocks[:, m : m + take])
+        vb = np.ascontiguousarray(v_blocks[:, m : m + take])
+        if n_pad > take:
+            pad = np.zeros(
+                (expect[0], n_pad - take, *expect[2:]), kb.dtype
+            )
+            kb = np.concatenate([kb, pad], axis=1)
+            vb = np.concatenate([vb, pad], axis=1)
+        imp = self._ensure_import()
+        self.pool_k, self.pool_v = imp(
+            self.pool_k,
+            self.pool_v,
+            jnp.asarray(kb.astype(self.dec.compute_dtype)),
+            jnp.asarray(vb.astype(self.dec.compute_dtype)),
+            jnp.asarray(dest),
+        )
+        for j, blk in enumerate(own):
+            displaced = self.radix.register(keys[m + j], toks[m + j], blk)
+            if displaced is not None:
+                self.free.append(displaced)
+        # Park deepest-first (matches _finish): LRU then evicts the
+        # deep end of the chain before its shallow prerequisites.
+        for blk in reversed(own):
+            self.radix.release(blk)
+        self._update_pool_gauges()
+        return take
 
     # -- internals --------------------------------------------------------
 

@@ -1,0 +1,339 @@
+#!/usr/bin/env python
+"""Fleet-serving stress microbench: a bursty, prefix-shared arrival
+mix over N replica decode servers, printed as ONE JSON line.
+
+Two questions priced here:
+
+  1. Is cache locality a real routing signal? The same scripted
+     workload — shared system prompts with Zipf reuse, bursty
+     arrivals — runs through `policy="prefix"` and
+     `policy="round_robin"`; the radix hit-rate gap between them is
+     the entire value of the advertisement/digest machinery, and
+     tokens/sec + TTFT p50/p99 show what the hit rate buys.
+  2. Does overload degrade or collapse? A flood beyond aggregate
+     capacity runs against a tight SLO + bounded queues; the headline
+     is shed rate > 0 WITH the queue-wait p99 of admitted traffic
+     bounded near the SLO (unbounded queueing would show p99 growing
+     with the flood length instead).
+
+Standalone:
+
+    JAX_PLATFORMS=cpu python scripts/bench_fleet.py
+    python scripts/bench_fleet.py --replicas 3 --requests 48
+
+Importable: `run_microbench(devices) -> dict` — bench.py runs it as a
+"fleet" extras section behind the supervisor/snapshot deadline
+machinery.
+
+Off-TPU the absolute tokens/sec is meaningless; the prefix-vs-rr hit
+rate gap, the shed accounting, and the relative TTFT are the headline
+numbers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _hist_state(reg, name: str, **labels) -> dict:
+    snap = reg.value(name, **labels)
+    return snap if snap else {"count": 0, "sum": 0.0, "buckets": []}
+
+
+def _quantile_since(before: dict, after: dict, q: float) -> float | None:
+    """Bucket-interpolated quantile of the observations recorded
+    BETWEEN two histogram snapshots (the registry is cumulative, so a
+    per-run quantile needs the bucket-count diff)."""
+    n = after["count"] - before["count"]
+    if n <= 0:
+        return None
+    b_cum = {e: c for e, c in before.get("buckets", [])}
+    target = q * n
+    lo = 0.0
+    for edge, cum in after["buckets"]:
+        d = cum - b_cum.get(edge, 0)
+        if d >= target and edge != "+Inf":
+            return float(edge)
+        lo = edge if edge != "+Inf" else lo
+    return float(lo) if lo else None
+
+
+def _workload(
+    rng, cfg, *, n_requests, n_sys, sys_len, suffix_max, steps_max
+):
+    """Prefix-shared request mix: each request is one of `n_sys`
+    shared system prompts (Zipf-ish reuse: prompt 0 twice as popular
+    as 1, etc.) plus a private suffix. sys_len is a block multiple so
+    the shared region is exactly the radix-cacheable run."""
+    import jax
+    import jax.numpy as jnp
+
+    sys_prompts = [
+        jax.random.randint(
+            jax.random.fold_in(jax.random.key(11), s),
+            (1, sys_len), 0, cfg.vocab_size,
+        )
+        for s in range(n_sys)
+    ]
+    weights = [1.0 / (s + 1) for s in range(n_sys)]
+    total_w = sum(weights)
+    reqs = []
+    for i in range(n_requests):
+        u = rng.random() * total_w
+        s = 0
+        acc = weights[0]
+        while acc < u and s < n_sys - 1:
+            s += 1
+            acc += weights[s]
+        t_suf = 4 + int(rng.random() * (suffix_max - 4))
+        suffix = jax.random.randint(
+            jax.random.fold_in(jax.random.key(13), i),
+            (1, t_suf), 0, cfg.vocab_size,
+        )
+        steps = 4 + int(rng.random() * (steps_max - 4))
+        reqs.append((jnp.concatenate([sys_prompts[s], suffix], axis=1),
+                     steps))
+    return sys_prompts, reqs
+
+
+def _drive(fe, reqs, *, burst: int, gap_s: float, paced: bool = False):
+    """Bursty submission: `burst` requests back to back, then a gap,
+    repeat. `paced=True` additionally waits for each burst's results
+    before the next burst submits — prefills complete and the digest
+    advertisements land, so routing sees the cache state the previous
+    burst created (un-paced, every decision races the first compile and
+    degenerates to load-routing). Returns (outputs in submission
+    order, shed_count)."""
+    from defer_tpu.fleet import ShedError
+
+    outs = []
+    shed = 0
+    pending = []
+    for i, (p, s) in enumerate(reqs):
+        try:
+            pending.append(fe.submit(p, s))
+        except ShedError:
+            shed += 1
+        if (i + 1) % burst == 0:
+            if paced:
+                outs.extend(fe.result(g, timeout=600) for g in pending)
+                pending = []
+            time.sleep(gap_s)
+    outs.extend(fe.result(g, timeout=600) for g in pending)
+    return outs, shed
+
+
+def run_microbench(
+    devices=None,
+    *,
+    n_replicas: int = 2,
+    num_layers: int = 2,
+    dim: int = 128,
+    num_heads: int = 4,
+    num_kv_heads: int = 2,
+    vocab_size: int = 512,
+    max_len: int = 256,
+    num_blocks: int = 40,
+    block_size: int = 16,
+    max_batch: int = 4,
+    num_requests: int = 24,
+    n_sys: int = 3,
+    sys_len: int = 32,
+    burst: int = 4,
+    gap_s: float = 0.02,
+    overload: bool = True,
+) -> dict:
+    """Run the prefix-shared workload under prefix-aware and
+    round-robin routing, then (optionally) an overload flood against a
+    tight SLO. Returns {config, prefix: {...}, round_robin: {...},
+    overload: {...}}."""
+    import random
+
+    import jax
+    import jax.numpy as jnp
+
+    from defer_tpu.fleet import FleetFrontend
+    from defer_tpu.models.gpt import GptDecoder
+    from defer_tpu.models.llama import llama_config
+    from defer_tpu.obs import get_registry
+
+    cfg = llama_config(
+        num_layers=num_layers,
+        dim=dim,
+        num_heads=num_heads,
+        num_kv_heads=num_kv_heads,
+        ffn_dim=dim * 2,
+        vocab_size=vocab_size,
+        max_len=max_len,
+    )
+    dec = GptDecoder(cfg, compute_dtype=jnp.float32)
+    params = dec.cast_params(dec.init(jax.random.key(0)))
+    if devices:
+        params = jax.device_put(params, devices[0])
+    rng = random.Random(1234)
+    sys_prompts, reqs = _workload(
+        rng, cfg,
+        n_requests=num_requests, n_sys=n_sys, sys_len=sys_len,
+        suffix_max=24, steps_max=16,
+    )
+    total_tokens = sum(s for _, s in reqs)
+    reg = get_registry()
+    shared = dict(
+        n_replicas=n_replicas,
+        num_blocks=num_blocks,
+        block_size=block_size,
+        max_batch=max_batch,
+        prefix_cache=True,
+    )
+    out: dict = {
+        "config": {
+            "replicas": n_replicas,
+            "num_layers": num_layers,
+            "dim": dim,
+            "heads": f"{num_heads}/{num_kv_heads}kv",
+            "num_blocks": num_blocks,
+            "block_size": block_size,
+            "max_batch": max_batch,
+            "requests": num_requests,
+            "system_prompts": n_sys,
+            "system_prompt_len": sys_len,
+            "burst": burst,
+            "total_tokens": total_tokens,
+        },
+    }
+
+    # Warm the jit caches on the full request mix — the step/prefill
+    # programs are memoized on the decoder and shared by every
+    # frontend, so without this the first measured arm eats all the
+    # compile time (every distinct prefill lane shape compiles).
+    fe = FleetFrontend(dec, params, policy="prefix", **shared)
+    try:
+        _drive(fe, reqs, burst=burst, gap_s=0, paced=True)
+    finally:
+        fe.close()
+
+    for policy in ("prefix", "round_robin"):
+        fe = FleetFrontend(dec, params, policy=policy, **shared)
+        hits0 = reg.value(
+            "defer_prefix_cache_hits_total", server="paged"
+        ) or 0
+        miss0 = reg.value(
+            "defer_prefix_cache_misses_total", server="paged"
+        ) or 0
+        ttft0 = _hist_state(reg, "defer_ttft_seconds", server="paged")
+        t0 = time.perf_counter()
+        try:
+            outs, _ = _drive(
+                fe, reqs, burst=burst, gap_s=gap_s, paced=True
+            )
+            jax.block_until_ready(outs[-1])
+        finally:
+            fe.close()
+        dt = time.perf_counter() - t0
+        hits = (reg.value(
+            "defer_prefix_cache_hits_total", server="paged"
+        ) or 0) - hits0
+        miss = (reg.value(
+            "defer_prefix_cache_misses_total", server="paged"
+        ) or 0) - miss0
+        ttft1 = _hist_state(reg, "defer_ttft_seconds", server="paged")
+        stats = fe.stats()
+        out[policy] = {
+            "tokens_per_sec": round(total_tokens / dt, 1),
+            "radix_hit_rate": round(hits / max(hits + miss, 1), 3),
+            "prefix_hits": hits,
+            "prefix_misses": miss,
+            "ttft_p50_s": _quantile_since(ttft0, ttft1, 0.5),
+            "ttft_p99_s": _quantile_since(ttft0, ttft1, 0.99),
+            "routed": stats["routed"],
+            "migrated_blocks": stats["migrated_blocks"],
+            "shed": stats["shed"],
+        }
+    out["hit_rate_gain"] = round(
+        out["prefix"]["radix_hit_rate"]
+        - out["round_robin"]["radix_hit_rate"], 3,
+    )
+
+    if overload:
+        # Flood well past aggregate capacity against a tight SLO and
+        # short queues: the contract is shed > 0 AND the realized
+        # queue-wait p99 of ADMITTED traffic staying bounded (the
+        # rolling window the shedder itself reads).
+        slo_s = 0.05
+        fe = FleetFrontend(
+            dec, params, policy="prefix",
+            slo_s=slo_s, max_queue=2, **shared,
+        )
+        flood = [
+            (r[0], r[1]) for r in reqs for _ in range(3)
+        ]
+        try:
+            outs, shed = _drive(fe, flood, burst=len(flood), gap_s=0)
+            p99s = [
+                fe.controller.wait_p99(i) for i in range(n_replicas)
+            ]
+        finally:
+            fe.close()
+        out["overload"] = {
+            "slo_s": slo_s,
+            "offered": len(flood),
+            "admitted": len(outs),
+            "shed": shed,
+            "shed_rate": round(shed / len(flood), 3),
+            "shed_reasons": fe.stats()["shed"],
+            "queue_wait_p99_s": [round(p, 4) for p in p99s],
+        }
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        description="fleet-serving microbench (one JSON line)"
+    )
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--dim", type=int, default=128)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--kv-heads", type=int, default=2)
+    ap.add_argument("--vocab", type=int, default=512)
+    ap.add_argument("--blocks", type=int, default=40)
+    ap.add_argument("--block-size", type=int, default=16)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--requests", type=int, default=24)
+    ap.add_argument("--sys-prompts", type=int, default=3)
+    ap.add_argument("--sys-len", type=int, default=32)
+    ap.add_argument("--burst", type=int, default=4)
+    ap.add_argument(
+        "--no-overload", action="store_true",
+        help="skip the SLO/shedding flood section",
+    )
+    args = ap.parse_args()
+    rec = run_microbench(
+        n_replicas=args.replicas,
+        num_layers=args.layers,
+        dim=args.dim,
+        num_heads=args.heads,
+        num_kv_heads=args.kv_heads,
+        vocab_size=args.vocab,
+        num_blocks=args.blocks,
+        block_size=args.block_size,
+        max_batch=args.batch,
+        num_requests=args.requests,
+        n_sys=args.sys_prompts,
+        sys_len=args.sys_len,
+        burst=args.burst,
+        overload=not args.no_overload,
+    )
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,16 @@
+"""NEGATIVE: the advertisement discipline fleet/router.py requires —
+snapshot the digest set UNDER the radix lock (cheap, bounded), publish
+OUTSIDE it. The serving thread's register/evict never wait on the
+fanout."""
+
+
+class Replica:
+    def publish_adverts(self):
+        with self.radix._lock:
+            digests = frozenset(self.radix.by_key)
+        self._board_sock.sendall(encode(digests))
+
+    def close(self):
+        with self.radix._lock:
+            self._closing = True
+        self._advert_thread.join()

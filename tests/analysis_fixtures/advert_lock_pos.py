@@ -1,0 +1,15 @@
+"""POSITIVE: digest-advertisement publish path that does blocking work
+while holding the radix lock — `register`/`evict` on the serving
+thread take the SAME lock, so admission stalls behind the
+advertisement fanout (the anti-pattern fleet/router.py documents)."""
+
+
+class Replica:
+    def publish_adverts(self):
+        with self.radix._lock:
+            digests = frozenset(self.radix.by_key)
+            self._board_sock.sendall(encode(digests))  # fanout under the lock
+
+    def close(self):
+        with self.radix._lock:
+            self._advert_thread.join()  # unbounded wait under the lock

@@ -31,6 +31,7 @@ CASES = [
     ("fresh-closure-jit", "fresh_closure", 2),
     ("prng-key-reuse", "prng_reuse", 1),
     ("lock-discipline", "lock_discipline", 2),
+    ("lock-discipline", "advert_lock", 2),
     ("obs-name-drift", "obs_drift", 3),
 ]
 

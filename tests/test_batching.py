@@ -206,3 +206,21 @@ def test_gather_bounds_rows_not_item_count():
     assert g.pending()
     b2, s2, _ = g.gather(q)
     assert s2 == [3] and b2.shape == (4, 8)
+
+
+def test_deadline_budget_machinery():
+    """Deadline is the shared monotonic budget both the gatherer's
+    flush SLO and fleet admission's enqueue wait run on: remaining
+    shrinks, elapsed grows, expiry is a one-way door."""
+    import time
+
+    from defer_tpu.runtime.batching import Deadline
+
+    dl = Deadline(0.05)
+    assert not dl.expired()
+    r0 = dl.remaining()
+    assert 0 < r0 <= 0.05
+    time.sleep(0.06)
+    assert dl.expired()
+    assert dl.remaining() <= 0
+    assert dl.elapsed() >= 0.06
