@@ -564,6 +564,28 @@ def bench_decode_window(devices) -> dict:
     return rec
 
 
+def bench_speculative(devices) -> dict:
+    """Paged speculative decoding (scripts/bench_paged.py): the same
+    request mix served at spec_k in {0,2,4} with a self-draft
+    (acceptance 1.0), pricing tokens/sec and dispatches-per-token per
+    k. Isolates the dispatch-amortization term — each two-dispatch
+    round commits up to k+1 tokens per slot."""
+    import importlib.util
+    import os
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "scripts",
+        "bench_paged.py",
+    )
+    spec = importlib.util.spec_from_file_location("bench_paged", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rec = mod.run_spec_sweep(devices)
+    log(f"speculative sweep: {rec}")
+    return rec
+
+
 def bench_disagg(devices) -> dict:
     """Disaggregated serving (scripts/bench_disagg.py): the same
     request mix through monolithic serve_paged and split serve_disagg
@@ -840,6 +862,7 @@ def run_bench() -> dict:
         "paged_server": None,
         "paged_attention": None,
         "decode_window": None,
+        "speculative": None,
         "disagg": None,
         "pallas_attention": None,
     }
@@ -987,6 +1010,7 @@ def run_bench() -> dict:
             ("paged_server", bench_paged_server),
             ("paged_attention", bench_paged_attention),
             ("decode_window", bench_decode_window),
+            ("speculative", bench_speculative),
             ("disagg", bench_disagg),
             ("fleet", bench_fleet),
             ("bert_base", bench_bert),

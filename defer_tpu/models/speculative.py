@@ -23,19 +23,19 @@ The reference has no serving stack at all (it streams CNN frames,
 reference src/test.py:30-41); this joins the beyond-reference serving
 surface alongside dynamic batching and int8 weights.
 
-Reproducibility note (sampled mode, temperature > 0): the PRNG key
-schedule consumes one `jax.random.split` per draft proposal and per
-verification round — plus ONE EXTRA split on every FULL-ACCEPT round,
-where the bonus token is sampled from the verify forward's final
-logits (`rng, sub_b = jax.random.split(rng)` below). That extra split
-means sampled speculative output is NOT stream-identical to
-`target.generate(..., rng=key)` with the same seed, and depends on
-the draft model and k (they shape which rounds fully accept): two
-runs agree only if seed, draft, k, and temperature/filter knobs all
-agree. The DISTRIBUTION is unchanged (each draw still uses a fresh
-subkey); only the key stream differs. Greedy mode (temperature 0)
-consumes no keys and stays bit-identical to the target's greedy
-decode.
+Reproducibility note (sampled mode, temperature > 0): sampled
+speculative output is NOT stream-identical to
+`target.generate(..., rng=key)` with the same seed — the full-accept
+bonus draw consumes an extra PRNG split per round, so the key stream
+depends on the draft and k. ARCHITECTURE.md "Speculative serving" has
+the full account. Greedy mode (temperature 0) consumes no keys and
+stays bit-identical to the target's greedy decode.
+
+This is the SOLO loop (one request, flat caches on both models). For
+serving-scale speculation over many concurrent requests, use
+`PagedDecodeServer(spec_k=...)` (runtime/paged.py) — it shares this
+module's accept rule via `batching.accept_lengths` and reports
+through the same `defer_spec_*` metrics.
 """
 
 from __future__ import annotations
@@ -92,7 +92,11 @@ def speculative_generate(
     by feeding the draft whatever it is missing.
     """
     if prompt_ids.shape[0] != 1:
-        raise ValueError("speculative decoding is batch-1 (scalar rewind)")
+        raise ValueError(
+            "speculative_generate is batch-1 (scalar rewind); for "
+            "batched speculative serving use "
+            "PagedDecodeServer(spec_k=...) — runtime/paged.py"
+        )
     for dec, name in ((target, "target"), (draft, "draft")):
         if getattr(dec, "rolling_cache", False):
             raise ValueError(
@@ -119,6 +123,13 @@ def speculative_generate(
         rng = jax.random.key(0)
 
     from defer_tpu.models.gpt import truncate_logits
+    from defer_tpu.obs.serving import ServingMetrics
+    from defer_tpu.runtime.batching import accept_lengths
+
+    # Shared defer_spec_* instruments (obs/serving.py), labelled by
+    # driver — fleet dashboards read the solo loop and the paged
+    # server's spec_k mode side by side.
+    obs = ServingMetrics("speculative")
 
     def filt(raw_logits):
         """Raw model logits -> FILTERED logits (temperature +
@@ -263,12 +274,17 @@ def speculative_generate(
                 axis=1,
             ).astype(ids.dtype)  # [1, k]
             # analysis: ignore[host-sync-in-hot-loop] greedy accept
-            # path: one batched bool-vector transfer per verify round
-            matches = np.asarray(jax.device_get(preds[0] == prop[0]))
-            a = k if matches.all() else int(matches.argmin())
+            # path: one batched transfer of (props, preds) per verify
+            # round, into the accept rule the paged spec_k path shares
+            props_h, preds_h = jax.device_get((prop, preds))
+            a = int(accept_lengths(props_h, preds_h)[0])
             replacement = None if a == k else preds[:, a : a + 1]
         rounds += 1
         accepted_total += a
+        obs.spec_rounds.inc()
+        obs.spec_proposed.inc(k)
+        if a:
+            obs.spec_accepted.inc(a)
 
         if a == k:
             # Bonus token (Leviathan/Chen): the verify forward's final
@@ -315,10 +331,13 @@ def speculative_generate(
         }
 
     ids = ids[:, : t0 + num_steps]
+    acceptance = accepted_total / max(1, rounds * k)
+    if rounds:
+        obs.spec_acceptance.set(acceptance)
     stats = {
         "target_steps": target_steps,
         "plain_steps": num_steps,
         "rounds": rounds,
-        "acceptance": accepted_total / max(1, rounds * k),
+        "acceptance": acceptance,
     }
     return ids, stats

@@ -145,6 +145,28 @@ class ServingMetrics:
             "on-device, or a stop sequence discarded the tail on "
             "drain)", labels,
         )
+        # Speculative decoding (models/speculative.py solo loop and
+        # runtime/paged.py paged serving both report through these).
+        # acceptance = accepted/proposed is the one-number health
+        # signal: the target-dispatch amortization k-token speculation
+        # buys is (1 + acceptance * k) tokens per verify forward.
+        self.spec_proposed = reg.counter(
+            "defer_spec_proposed_total",
+            "Draft tokens proposed to a target verify forward", labels,
+        )
+        self.spec_accepted = reg.counter(
+            "defer_spec_accepted_total",
+            "Proposed draft tokens the target accepted", labels,
+        )
+        self.spec_rounds = reg.counter(
+            "defer_spec_rounds_total",
+            "Speculative propose/verify rounds executed", labels,
+        )
+        self.spec_acceptance = reg.gauge(
+            "defer_spec_acceptance",
+            "Running fraction of proposed draft tokens accepted",
+            labels,
+        )
 
 
 class DisaggMetrics:

@@ -510,6 +510,204 @@ def paged_flash_decode(
     return out[:, :, :g, :].reshape(b, hq, d)
 
 
+def _prefill_lo_hi(p0, t_q: int, block_size: int, window: int | None):
+    """First/last LIVE K-block (inclusive) for a prefill window of
+    `t_q` query tokens at absolute positions p0..p0+t_q-1: the last
+    query attends through block (p0+t_q-1)//bs, the first one back to
+    max(p0-window+1, 0). Shared by the compute gate and the index
+    maps' DMA-clamping, mirroring `_decode_lo_hi`."""
+    hi = (p0 + t_q - 1) // block_size
+    lo = (
+        jnp.maximum(p0 - window + 1, 0) // block_size
+        if window is not None
+        else jnp.int32(0)
+    )
+    return lo, hi
+
+
+def _paged_prefill_kernel(
+    tables_ref,
+    start_ref,
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    m_scr,
+    l_scr,
+    acc_scr,
+    *,
+    sm_scale: float,
+    block_size: int,
+    group: int,
+    window: int | None,
+    num_tb: int,
+    t_q: int,
+):
+    """One (batch, kv-head, table-column) cell of paged flash-PREFILL:
+    `_paged_decode_kernel` generalized from one query token to a
+    window of T. The query tile is token-major — row r is query token
+    r//G of group row r%G — so the causal mask is per ROW: row r
+    attends keys at columns <= start + r//G (each window token sees
+    the pool history plus its own predecessors in the window). K/V
+    tiles still arrive through the block-table index maps: chunked
+    prefill and the speculative verify forward read the pool directly,
+    no contiguous gather. Rows padded past T*G attend a superset of
+    live columns and are sliced off by the wrapper."""
+    tb = pl.program_id(2)
+    p0 = start_ref[pl.program_id(0)]
+    lo, hi = _prefill_lo_hi(p0, t_q, block_size, window)
+
+    @pl.when(tb == 0)
+    def _init():
+        m_scr[:] = jnp.full(m_scr.shape, _MASK_VALUE, jnp.float32)
+        l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    @pl.when((tb >= lo) & (tb <= hi))
+    def _fold():
+        q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # (R, d)
+        r = q.shape[0]
+        k = k_ref[0, 0].astype(jnp.float32)  # (block_size, d)
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = lax.dot_general(
+            q,
+            k,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (R, block_size)
+        cols = tb * block_size + lax.broadcasted_iota(
+            jnp.int32, (r, block_size), 1
+        )
+        qpos = (
+            p0
+            + lax.broadcasted_iota(jnp.int32, (r, block_size), 0) // group
+        )
+        mask = cols <= qpos
+        if window is not None:
+            mask &= cols > qpos - window
+        s = jnp.where(mask, s, _MASK_VALUE)
+        m = m_scr[:]
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        m_scr[:] = m_new
+        l_scr[:] = l_scr[:] * alpha + p.sum(axis=-1)
+        acc_scr[:] = acc_scr[:] * alpha[:, None] + lax.dot_general(
+            p,
+            v,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(tb == num_tb - 1)
+    def _emit():
+        o_ref[0, 0] = (acc_scr[:] / l_scr[:][:, None]).astype(
+            o_ref.dtype
+        )
+
+
+def paged_flash_prefill(
+    q: jax.Array,
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    tables: jax.Array,
+    start: jax.Array,
+    *,
+    window: int | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Paged flash-prefill: a window of T query tokens per slot
+    attending its block table directly — the prefill/verify companion
+    to `paged_flash_decode`, closing the last full-pool gather in the
+    serving path (chunked prefill and the speculative verify forward
+    both route here).
+
+    q [B, Hq, T, Dh] — T new tokens per slot, already rotated/projected
+    for absolute positions start..start+T-1; pool_k/pool_v
+    [NB, Hkv, bs, Dh] — ONE layer of the shared block pool, with the
+    window's own K/V rows ALREADY scattered in (write-then-attend, the
+    blockwise path's contract); tables [B, MB] int32 pool indices
+    (unallocated entries = trash block 0); start [B] int32 = absolute
+    position of each slot's FIRST window token. Returns [B, Hq, T, Dh].
+
+    Causality is per window row: token t attends pool columns
+    <= start+t, so rejected speculative rows left stale past `pos`
+    are never read. The T*G query rows are zero-padded to the TPU
+    sublane tile and sliced back; tables/start ride scalar prefetch so
+    dead columns clamp onto live tiles exactly like the decode
+    kernel."""
+    b, hq, t_q, d = q.shape
+    nb, hkv, bs, _ = pool_k.shape
+    if hq % hkv:
+        raise ValueError(f"Hq={hq} must be a multiple of Hkv={hkv}")
+    if tables.ndim != 2 or tables.shape[0] != b:
+        raise ValueError(
+            f"tables must be [B={b}, MB], got {tables.shape}"
+        )
+    g = hq // hkv
+    mb = tables.shape[1]
+    r = t_q * g
+    r_pad = max(8, -(-r // 8) * 8)
+    # Token-major query rows: row t*G + gi is window token t, group
+    # row gi — the kernel recovers the token index as r//G.
+    qg = (
+        q.reshape(b, hkv, g, t_q, d)
+        .transpose(0, 1, 3, 2, 4)
+        .reshape(b, hkv, r, d)
+    )
+    if r_pad != r:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, r_pad - r), (0, 0)))
+    tables = jnp.asarray(tables, jnp.int32)
+    start1 = jnp.broadcast_to(
+        jnp.asarray(start, jnp.int32).reshape(-1), (b,)
+    )
+    kernel = functools.partial(
+        _paged_prefill_kernel,
+        sm_scale=d**-0.5,
+        block_size=bs,
+        group=g,
+        window=window,
+        num_tb=mb,
+        t_q=t_q,
+    )
+
+    def kv_index(i, j, tb, tables_ref, start_ref):
+        lo, hi = _prefill_lo_hi(start_ref[i], t_q, bs, window)
+        return (tables_ref[i, jnp.clip(tb, lo, hi)], j, 0, 0)
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, hkv, mb),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, r_pad, d),
+                lambda i, j, tb, tables_ref, start_ref: (i, j, 0, 0),
+            ),
+            pl.BlockSpec((1, 1, bs, d), kv_index),
+            pl.BlockSpec((1, 1, bs, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, r_pad, d),
+            lambda i, j, tb, tables_ref, start_ref: (i, j, 0, 0),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((r_pad,), jnp.float32),
+            pltpu.VMEM((r_pad,), jnp.float32),
+            pltpu.VMEM((r_pad, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, r_pad, d), q.dtype),
+        interpret=interpret,
+    )(tables, start1, qg, pool_k, pool_v)
+    out = out[:, :, :r, :].reshape(b, hkv, t_q, g, d)
+    return out.transpose(0, 1, 3, 2, 4).reshape(b, hq, t_q, d)
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,

@@ -21,6 +21,7 @@ import time
 from typing import Any
 
 import jax.numpy as jnp
+import numpy as np
 
 from defer_tpu.obs.metrics import get_registry
 from defer_tpu.runtime.host_io import STOP
@@ -244,6 +245,36 @@ def window_drain_order(valid_lens, width: int):
         for i, n in enumerate(valid_lens):
             if t < n:
                 yield t, i
+
+
+def accept_lengths(props, preds):
+    """Greedy speculative accept test, batched (the Leviathan/Chen
+    rule at temperature 0): per row, the accepted length is the index
+    of the FIRST draft token that disagrees with the target's argmax
+    at the same position — or k when the whole proposal matches.
+    `props` [B, k] draft proposals; `preds` [B, k] target argmax at
+    the k proposal positions (verify-forward rows 0..k-1: row j is
+    the target's choice GIVEN props[:j] accepted). Host-side numpy on
+    already-fetched values — the single batched accept-test sync both
+    speculative drivers (models/speculative.py solo loop,
+    runtime/paged.py `spec_k`) share, so their accept semantics can
+    never drift. Returns [B] int64."""
+    # analysis: ignore[host-sync-in-hot-loop] no-op on the host numpy
+    # both callers pass (their round's ONE batched transfer happens —
+    # and is justified — at the fetch site)
+    props = np.asarray(props)
+    # analysis: ignore[host-sync-in-hot-loop] same: already host-side
+    preds = np.asarray(preds)
+    if props.shape != preds.shape or props.ndim != 2:
+        raise ValueError(
+            f"props/preds must be matching [B, k], got "
+            f"{props.shape}/{preds.shape}"
+        )
+    mismatch = props != preds
+    # argmax of an all-False row is 0; the any() mask routes those
+    # (full-accept) rows to k.
+    first_bad = mismatch.argmax(axis=1)
+    return np.where(mismatch.any(axis=1), first_bad, props.shape[1])
 
 
 def split_output(out: Any, sizes: list[int]) -> list[Any]:

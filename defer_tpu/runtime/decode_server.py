@@ -164,6 +164,141 @@ class SlotSampler:
         return nxt
 
 
+class DraftLanes:
+    """Per-slot flat lanes for a speculative DRAFT decoder — the
+    draft-side bookkeeping seam the paged server's `spec_k` mode rides
+    (runtime/paged.py).
+
+    The target's K/V lives in the paged pool; the draft keeps a plain
+    flat cache of max_batch lanes (draft models are small, so lane
+    waste is cheap and the contiguous layout keeps the k-step proposal
+    scan trivial). Host-side `pos` is the truth for how many COMMITTED
+    tokens each lane covers: the server passes it down every round
+    (idle/non-speculating rows pinned to 0, the flat server's
+    idle-slot idiom), so device-side position drift from dummy rows
+    can never accumulate.
+
+    `propose()` is ONE fused dispatch per round: a [B, 2] catch-up
+    step consumes each slot's 1-2 committed-but-unconsumed tokens
+    (1 after a rejection, 2 after a full accept — the lag the solo
+    speculative loop's `n0 - d_pos in (1, 2)` assertion pins), then a
+    `lax.scan` of k-1 single-token greedy steps emits the remaining
+    proposals. Slots with lag 1 feed their token twice and advance by
+    1 — the duplicate row is written at pos+1 and immediately
+    overwritten by the first scan step."""
+
+    def __init__(self, dec: Any, params: dict, max_batch: int):
+        if getattr(dec, "rolling_cache", False):
+            raise ValueError(
+                "a rolling-cache draft cannot rewind rejected rows"
+            )
+        if getattr(dec, "decode_step_fn", None) is None:
+            raise ValueError(
+                "the draft decoder must expose decode_step_fn() "
+                f"(models/gpt.py GptDecoder); {type(dec).__name__} "
+                "does not"
+            )
+        dec.decode_step_fn()  # SpmdGptDecoder raises at construction
+        self.dec = dec
+        self.params = params
+        self.B = max_batch
+        cache = dec.init_cache(max_batch)
+        self.ck = cache["k"]
+        self.cv = cache["v"]
+        self.pos = np.zeros((max_batch,), np.int32)
+
+    def admit(self, i: int, prompt: jax.Array) -> None:
+        """Prefill slot i's draft lane with the request's FULL prompt
+        (pow2-bucketed, the shared admission idiom) and lane-insert it
+        — `_install_lane` for the draft cache. Afterwards the lane
+        covers the t0 prompt tokens; the first generated token is the
+        slot's initial pending feed (server-side)."""
+        t0 = prompt.shape[1]
+        pad = 1 << (t0 - 1).bit_length()
+        pad = min(pad, self.dec.cfg.max_len)
+        padded = jnp.concatenate(
+            [prompt, jnp.zeros((1, pad - t0), prompt.dtype)], axis=1
+        )
+        small = self.dec.init_cache(1)
+        _, small = self.dec.make_step()(self.params, small, padded)
+        self.ck = lax.dynamic_update_slice(
+            self.ck, small["k"], (0, i, 0, 0, 0)
+        )
+        self.cv = lax.dynamic_update_slice(
+            self.cv, small["v"], (0, i, 0, 0, 0)
+        )
+        self.pos[i] = t0
+
+    def release(self, i: int) -> None:
+        self.pos[i] = 0
+
+    def _build_propose(self, k: int):
+        dec = self.dec
+
+        def build():
+            raw = dec.decode_step_fn()
+
+            def propose(params, dk, dv, dpos, feed2, adv):
+                cache = {"k": dk, "v": dv, "pos": dpos}
+                logits2, cache = raw(params, cache, feed2)
+                # Row adv-1 is the prediction after the LAST real
+                # pending token; later rows are duplicate-feed noise.
+                first_l = jnp.take_along_axis(
+                    logits2,
+                    jnp.maximum(adv - 1, 0)[:, None, None],
+                    axis=1,
+                )[:, 0, :]
+                nxt = jnp.argmax(first_l, axis=-1).astype(jnp.int32)
+                # Correct per-slot positions after the variable-lag
+                # catch-up (the raw step advanced every row by 2).
+                pos1 = dpos + adv
+
+                def body(carry, _):
+                    ck, cv, pos, tok = carry
+                    lg, c2 = raw(
+                        params,
+                        {"k": ck, "v": cv, "pos": pos},
+                        tok[:, None],
+                    )
+                    t2 = jnp.argmax(lg[:, -1, :], axis=-1).astype(
+                        jnp.int32
+                    )
+                    return (c2["k"], c2["v"], c2["pos"], t2), t2
+
+                (dk, dv, _, _), rest = lax.scan(
+                    body,
+                    (cache["k"], cache["v"], pos1, nxt),
+                    None,
+                    length=k - 1,
+                )
+                props = jnp.concatenate([nxt[:, None], rest.T], axis=1)
+                return dk, dv, props
+
+            return jax.jit(propose, donate_argnums=(1, 2))
+
+        return cached_step(dec, ("spec_propose", self.B, k), build)
+
+    def propose(self, k, posm, feed2, adv):
+        """One fused draft dispatch: catch up on pending committed
+        tokens, then emit k greedy proposals per slot. `posm` [B] =
+        host-truth lane coverage, non-speculating rows 0; `feed2`
+        [B, 2] pending tokens (lag-1 rows duplicated); `adv` [B] in
+        {0, 1, 2} = real pending count. Returns device [B, k]
+        proposals (garbage rows for adv=0 slots — the caller masks by
+        slot). Lane coverage afterwards is posm + adv + k - 1 for
+        speculating rows: the k-th proposal is never self-consumed."""
+        prog = self._build_propose(k)
+        self.ck, self.cv, props = prog(
+            self.params,
+            self.ck,
+            self.cv,
+            jnp.asarray(posm, jnp.int32),
+            jnp.asarray(feed2, jnp.int32),
+            jnp.asarray(adv, jnp.int32),
+        )
+        return props
+
+
 @dataclasses.dataclass
 class _Slot:
     req: int | None = None

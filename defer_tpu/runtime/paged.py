@@ -86,8 +86,8 @@ from defer_tpu.models.gpt import (
 )
 from defer_tpu.obs.serving import ServerStats, ServingMetrics
 from defer_tpu.ops.pallas_attention import _MASK_VALUE
-from defer_tpu.runtime.batching import window_drain_order
-from defer_tpu.runtime.decode_server import SlotSampler
+from defer_tpu.runtime.batching import accept_lengths, window_drain_order
+from defer_tpu.runtime.decode_server import DraftLanes, SlotSampler
 from defer_tpu.runtime.stopping import matcher_or_none, normalize_stops
 
 
@@ -144,6 +144,61 @@ def _blockwise_attend(q, pk_l, pv_l, tables, pos, bs, nb_live, window):
     _, l, acc = lax.fori_loop(0, nb_live, body, init)
     out = acc / l[..., None]  # [B, Hkv, G, Dh]
     return out.astype(q.dtype).reshape(b, 1, hq * dh)
+
+
+def _blockwise_attend_mt(q, pk_l, pv_l, tables, pos, bs, nb_live, window):
+    """Multi-token sibling of _blockwise_attend: T query rows per slot
+    (a speculative verify window or a prefill chunk), each causally
+    masked at its OWN position pos[b] + t, folded through the block
+    table with the same per-column online-softmax recurrence. Rows a
+    slot is not using (pad rows of a prefill tail, the k speculative
+    rows of a sampled slot) produce garbage the caller ignores — the
+    mask keeps them from reading past their qpos, nothing more.
+
+    q [B, Hq, T, Dh]; pos [B] = the FIRST query row's position (row t
+    attends through pos + t inclusive). Returns [B, T, Hq*Dh] in
+    q.dtype, the layout _attn_out takes. Same tie-tolerant contract as
+    the single-token fold."""
+    b, hq, t, dh = q.shape
+    hkv = pk_l.shape[1]
+    g = hq // hkv
+    qg = q.reshape(b, hkv, g, t, dh).astype(jnp.float32)
+    qg = qg * (dh**-0.5)
+    qpos = pos[:, None] + jnp.arange(t)[None, :]  # [B, T]
+    span = jnp.arange(bs)
+
+    def body(j, carry):
+        m, l, acc = carry
+        blk = tables[:, j]  # [B]
+        k = pk_l[blk].astype(jnp.float32)  # [B, Hkv, bs, Dh]
+        v = pv_l[blk].astype(jnp.float32)
+        s = jnp.einsum("bkgtd,bksd->bkgts", qg, k)
+        cols = j * bs + span  # [bs]
+        mask = cols[None, None, :] <= qpos[:, :, None]  # [B, T, bs]
+        if window is not None:
+            mask &= cols[None, None, :] > qpos[:, :, None] - window
+        s = jnp.where(mask[:, None, None, :, :], s, _MASK_VALUE)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l_new = l * alpha + p.sum(axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bkgts,bksd->bkgtd", p, v
+        )
+        return m_new, l_new, acc_new
+
+    init = (
+        jnp.full((b, hkv, g, t), _MASK_VALUE, jnp.float32),
+        jnp.zeros((b, hkv, g, t), jnp.float32),
+        jnp.zeros((b, hkv, g, t, dh), jnp.float32),
+    )
+    _, l, acc = lax.fori_loop(0, nb_live, body, init)
+    out = acc / l[..., None]  # [B, Hkv, G, T, Dh]
+    return (
+        out.transpose(0, 3, 1, 2, 4)
+        .reshape(b, t, hq * dh)
+        .astype(q.dtype)
+    )
 
 
 class PrefixBlockCache:
@@ -350,9 +405,42 @@ class PagedDecodeServer:
         prefix_cache: bool = False,
         attention: str = "gathered",
         decode_window: int = 1,
+        spec_draft: Any = None,
+        spec_params: dict | None = None,
+        spec_k: int = 0,
+        prefill_chunk: int | None = None,
     ):
         """`on_token(request_id, token_id, done)` — optional streaming
         callback, same contract as the flat server's.
+
+        `spec_k` — speculative decoding (ARCHITECTURE.md "Speculative
+        serving"): a DRAFT decoder (`spec_draft`/`spec_params`, same
+        tokenizer/vocab, typically much smaller) proposes k greedy
+        tokens per GREEDY slot per round, and the target verifies all
+        k+1 positions in ONE block-table-indexed multi-token forward —
+        accepted rows land in the paged pool as one multi-row scatter,
+        rows a slot is not speculating (sampled slots, idle slots)
+        redirect to trash block 0, and rejected rows go stale behind
+        the position mask until the next round rewrites them. Greedy
+        output is bit-identical to spec_k=0; sampled slots ride the
+        verify forward's first row and advance one token per round
+        from the SAME key stream as spec_k=0. The default 0 keeps the
+        classic tick loop untouched. Composes with prefix_cache and
+        mixed sampling; raises with decode_window > 1 (both amortize
+        host dispatches — fuse one way or the other), constructor
+        prefix_ids (the draft lane has no shared-prefix plumbing),
+        multi-LoRA (the draft is one model), and submit_prefilled
+        (the draft never saw the prompt).
+
+        `prefill_chunk` — chunked POOL-NATIVE prefill: admission runs
+        the prompt through the multi-token paged step in chunks of
+        this many tokens, writing K/V straight into the allocated
+        blocks through the block table instead of materializing a
+        contiguous max_len lane and paging it in afterwards. With
+        attention="blockwise"/"pallas" the chunk's reads scale with
+        the prompt's LIVE blocks, never with pool size (the
+        `defer_kv_rows_*` counters price it). None (default) keeps
+        the contiguous prefill + insert path.
 
         `decode_window` — decode sub-steps fused into ONE jitted host
         dispatch (K), the paged twin of DecodeServer's parameter (its
@@ -404,6 +492,46 @@ class PagedDecodeServer:
         if decode_window < 1:
             raise ValueError(
                 f"decode_window must be >= 1, got {decode_window}"
+            )
+        if spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {spec_k}")
+        if (spec_draft is not None or spec_params is not None) and not spec_k:
+            raise ValueError(
+                "spec_draft/spec_params provided but spec_k == 0 — "
+                "pass spec_k >= 1 to turn speculation on"
+            )
+        if spec_k:
+            if spec_draft is None or spec_params is None:
+                raise ValueError(
+                    "spec_k > 0 needs both spec_draft and spec_params "
+                    "(the proposal model and its weights)"
+                )
+            if decode_window > 1:
+                raise ValueError(
+                    "spec_k > 0 and decode_window > 1 both fuse "
+                    "multiple tokens into one host dispatch — compose "
+                    "is unsupported, pick one"
+                )
+            if prefix_ids is not None:
+                raise ValueError(
+                    "spec_k > 0 does not compose with constructor "
+                    "prefix_ids (the draft lane has no shared-prefix "
+                    "plumbing); use prefix_cache=True"
+                )
+            if self.multi_lora:
+                raise ValueError(
+                    "spec_k > 0 with multi-LoRA is unsupported: one "
+                    "draft model cannot propose for per-slot adapters"
+                )
+            if spec_draft.cfg.max_len < dec.cfg.max_len:
+                raise ValueError(
+                    f"draft max_len {spec_draft.cfg.max_len} < target "
+                    f"max_len {dec.cfg.max_len}: the draft lane must "
+                    "cover every position the target can reach"
+                )
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {prefill_chunk}"
             )
         self.decode_window = decode_window
         self.attention = attention
@@ -462,6 +590,21 @@ class PagedDecodeServer:
         self._insert = None
         self._insert_dyn = None
         self._import = None
+        self._mt = None
+        self.spec_k = spec_k
+        self.prefill_chunk = prefill_chunk
+        # Draft lanes (runtime/decode_server.py::DraftLanes): the
+        # draft model's flat per-slot K/V plus host position truth.
+        self._draft = (
+            DraftLanes(spec_draft, spec_params, max_batch)
+            if spec_k
+            else None
+        )
+        # Host-side speculation totals (the obs counters' mirrors, for
+        # ServerStats snapshots without a registry read).
+        self.spec_rounds_n = 0
+        self.spec_proposed_n = 0
+        self.spec_accepted_n = 0
         self.prefix_len = 0
         self.shared_blocks: list[int] = []
         self._prefix_cache = None
@@ -580,10 +723,21 @@ class PagedDecodeServer:
         t0 = prompt_ids.shape[1]
         if t0 < 1 or num_steps < 1:
             raise ValueError("need at least 1 prompt token and 1 step")
-        if self.prefix_len + t0 + num_steps > self.dec.cfg.max_len:
+        # spec_k rows of write headroom: a verify forward at position
+        # p writes candidate rows through p + spec_k, and the gathered
+        # path's contiguous-lane write must never clamp (clamping
+        # would shift real rows). spec_k is 0 when speculation is off.
+        if (
+            self.prefix_len + t0 + num_steps + self.spec_k
+            > self.dec.cfg.max_len
+        ):
+            extra = (
+                f" + spec_k {self.spec_k} headroom" if self.spec_k else ""
+            )
             raise ValueError(
                 f"prefix {self.prefix_len} + prompt {t0} + steps "
-                f"{num_steps} exceeds max_len {self.dec.cfg.max_len}"
+                f"{num_steps}{extra} exceeds max_len "
+                f"{self.dec.cfg.max_len}"
             )
         need = self._own_need(t0, num_steps)
         usable = self.pool_k.shape[1] - 1 - len(self.shared_blocks)
@@ -640,6 +794,12 @@ class PagedDecodeServer:
                 "externally prefilled admission supports the base "
                 "model only (adapter-specific K/V would need the "
                 "worker to run the same adapter banks)"
+            )
+        if self.spec_k:
+            raise ValueError(
+                "externally prefilled admission does not compose with "
+                "speculative decoding: the draft never prefilled this "
+                "prompt, so it has no K/V to propose from"
             )
         prompt = np.asarray(prompt_ids)
         if prompt.ndim != 2 or prompt.shape[0] != 1:
@@ -1096,6 +1256,180 @@ class PagedDecodeServer:
 
         return step
 
+    def _ensure_mt(self):
+        """The multi-token paged step (speculative verify forwards and
+        chunked pool-native prefill share it): built lazily, memoized
+        on the decoder like every other paged program. One memo entry
+        per attention mode; jit then caches per (B, T) shape — the
+        spec path runs a single (max_batch, k+1) trace in steady
+        state, prefill chunks a single (1, chunk) trace plus pow2
+        tails."""
+        if self._mt is None:
+            from defer_tpu.utils.memo import cached_step
+
+            self._mt = cached_step(
+                self.dec,
+                ("paged_mt", self.bs, self.attention),
+                lambda: jax.jit(
+                    self._mt_body(), donate_argnums=(1, 2)
+                ),
+            )
+        return self._mt
+
+    def _mt_body(self):
+        """The RAW multi-token paged step: T tokens per slot in one
+        forward, reading K/V through the block table and scattering
+        all T new rows back in one multi-row write.
+
+        step(params, pk, pv, tables, pos, ids [B, T], n_keep [B],
+        keep_from [B], adapter_ids) -> (logits [B, T, V], pk, pv).
+
+        Row t of slot b sits at absolute position pos[b] + t. The
+        write DESTINATION redirects to trash block 0 (the module
+        invariant) for any row the slot is not keeping: row index
+        >= n_keep[b] (a sampled slot keeps only its first row during a
+        speculative round, an idle slot none, a prefill tail's pad
+        rows none) or absolute position < keep_from[b] (radix HIT
+        blocks are other requests' memory — same rule as the
+        dynamic-skip insert). Speculative candidate rows ARE kept:
+        accepted ones become committed history, rejected ones go
+        stale behind the position mask and the next round's verify
+        span rewrites them — the dead-write idiom, no second pass.
+
+        Attention per mode mirrors the single-token step bodies:
+        gathered runs GptDecoder._block on the contiguous pool view
+        (bit-exact reference — row 0's logits are bit-identical to
+        the K=1 tick's, which is what pins spec greedy parity);
+        blockwise folds the pool through _blockwise_attend_mt;
+        pallas calls the block-table-indexed prefill kernel
+        (ops/pallas_attention.py::paged_flash_prefill)."""
+        dec, bs = self.dec, self.bs
+        attention = self.attention
+        window = dec.cfg.window
+        if attention == "pallas":
+            from defer_tpu.models.gpt import _flash_decode_mode
+            from defer_tpu.ops.pallas_attention import (
+                paged_flash_prefill,
+            )
+
+            interpret = _flash_decode_mode() != "tpu"
+
+        def step(
+            params, pk, pv, tables, pos, ids, n_keep, keep_from,
+            adapter_ids,
+        ):
+            b, t = ids.shape
+            mb = tables.shape[1]
+            rows = jnp.arange(b)
+            steps_t = jnp.arange(t)
+            pvec = pos[:, None] + steps_t[None, :]  # [B, T]
+            # Write destinations: each row's (block, row-in-block),
+            # with dropped rows redirected to trash block 0. The
+            # block-column clamp keeps headroom rows past the table
+            # (only reachable for dead writes) in range.
+            blk = tables[
+                rows[:, None], jnp.minimum(pvec // bs, mb - 1)
+            ]  # [B, T]
+            keep = (steps_t[None, :] < n_keep[:, None]) & (
+                pvec >= keep_from[:, None]
+            )
+            dest = jnp.where(keep, blk, 0)
+            rowi = pvec % bs
+            x = dec._embed_tokens(params, ids, pos)
+
+            if attention == "gathered":
+
+                def body(carry, layer):
+                    x = carry
+                    p, pk_l, pv_l = layer
+                    kc = pk_l[tables]  # [B, MB, Hkv, bs, Dh]
+                    vc = pv_l[tables]
+                    b_, mb_, hkv, _, dh = kc.shape
+                    kc = kc.transpose(0, 2, 1, 3, 4).reshape(
+                        b_, hkv, mb_ * bs, dh
+                    )
+                    vc = vc.transpose(0, 2, 1, 3, 4).reshape(
+                        b_, hkv, mb_ * bs, dh
+                    )
+                    out, kc, vc = dec._block(
+                        p, x, kc, vc, pos, adapter_ids=adapter_ids
+                    )
+                    # Multi-row scatter-back: T fresh rows per slot.
+                    new_k = kc[rows[:, None], :, pvec, :]
+                    new_v = vc[rows[:, None], :, pvec, :]
+                    pk_l = pk_l.at[dest, :, rowi, :].set(new_k)
+                    pv_l = pv_l.at[dest, :, rowi, :].set(new_v)
+                    return out, (pk_l, pv_l)
+
+            elif attention == "blockwise":
+
+                def body(carry, layer):
+                    x = carry
+                    p, pk_l, pv_l = layer
+                    q, k_new, v_new = dec._attn_qkv(
+                        p, x, pos, adapter_ids=adapter_ids
+                    )  # q [B,Hq,T,Dh]; k/v_new [B,Hkv,T,Dh]
+                    # Write-then-attend, like every paged step.
+                    pk_l = pk_l.at[dest, :, rowi, :].set(
+                        k_new.transpose(0, 2, 1, 3)
+                    )
+                    pv_l = pv_l.at[dest, :, rowi, :].set(
+                        v_new.transpose(0, 2, 1, 3)
+                    )
+                    nb_live = jnp.minimum(
+                        (jnp.max(pos) + t - 1) // bs + 1, mb
+                    )
+                    attn = _blockwise_attend_mt(
+                        q, pk_l, pv_l, tables, pos, bs, nb_live,
+                        window,
+                    )
+                    out = dec._attn_out(
+                        p, x, attn, adapter_ids=adapter_ids
+                    )
+                    return out, (pk_l, pv_l)
+
+            else:  # pallas
+
+                def body(carry, layer):
+                    x = carry
+                    p, pk_l, pv_l = layer
+                    q, k_new, v_new = dec._attn_qkv(
+                        p, x, pos, adapter_ids=adapter_ids
+                    )
+                    pk_l = pk_l.at[dest, :, rowi, :].set(
+                        k_new.transpose(0, 2, 1, 3)
+                    )
+                    pv_l = pv_l.at[dest, :, rowi, :].set(
+                        v_new.transpose(0, 2, 1, 3)
+                    )
+                    b_, hq, t_, dh = q.shape
+                    attn = paged_flash_prefill(
+                        q,
+                        pk_l,
+                        pv_l,
+                        tables,
+                        pos,
+                        window=window,
+                        interpret=interpret,
+                    )  # [B, Hq, T, Dh]
+                    attn = (
+                        attn.transpose(0, 2, 1, 3)
+                        .reshape(b_, t_, hq * dh)
+                        .astype(x.dtype)
+                    )
+                    out = dec._attn_out(
+                        p, x, attn, adapter_ids=adapter_ids
+                    )
+                    return out, (pk_l, pv_l)
+
+            x, (pk, pv) = lax.scan(
+                body, x, (params["stack"], pk, pv)
+            )
+            logits = dec._final_logits(params, x)
+            return logits, pk, pv
+
+        return step
+
     def _build_window(self, mode: str):
         """The fused K-sub-step paged decode program for one sampling
         mode ("argmax" | "nosort" | "sort" — the bit-identical trio
@@ -1277,6 +1611,83 @@ class PagedDecodeServer:
 
         return jax.jit(gather)
 
+    def _prefill_paged(
+        self, prompt, table_row, *, base, keep_from, adapter_id
+    ):
+        """Chunked POOL-NATIVE prefill: run `prompt` through the
+        multi-token paged step in prefill_chunk-token chunks, writing
+        K/V straight into the allocated blocks through the block
+        table — no contiguous max_len lane, no insert pass, and with
+        blockwise/pallas attention each chunk reads only the LIVE
+        span (accounted per chunk, pool-size independent). Returns
+        the [1, V] logits row of the LAST real prompt position (the
+        first generated token samples from it).
+
+        `base` — absolute position of prompt[:, 0] (the global
+        prefix_ids length, or a radix walk's reuse point); `keep_from`
+        — positions below it write to trash block 0 (radix HIT blocks
+        already hold those rows and belong to every chain holder).
+        Tail chunks pow2-pad, capped so the deepest write stays
+        inside the table span (the gathered path's contiguous-lane
+        write must never clamp)."""
+        mt = self._ensure_mt()
+        C = self.prefill_chunk
+        t0 = prompt.shape[1]
+        tab = jnp.asarray(table_row[None, :].copy())
+        adapter = jnp.full((1,), adapter_id, jnp.int32)
+        kf = jnp.asarray([keep_from], jnp.int32)
+        limit = self.MB * self.bs
+        logits_row = None
+        start = 0
+        while start < t0:
+            real = min(C, t0 - start)
+            pos0 = base + start
+            pad_t = 1 << (real - 1).bit_length()
+            pad_t = min(max(pad_t, 1), min(C, limit - pos0))
+            chunk = prompt[:, start : start + real]
+            if pad_t > real:
+                chunk = jnp.concatenate(
+                    [chunk, jnp.zeros((1, pad_t - real), chunk.dtype)],
+                    axis=1,
+                )
+            logits, self.pool_k, self.pool_v = mt(
+                self.params,
+                self.pool_k,
+                self.pool_v,
+                tab,
+                jnp.asarray([pos0], jnp.int32),
+                chunk.astype(jnp.int32),
+                jnp.asarray([real], jnp.int32),
+                kf,
+                adapter,
+            )
+            self._account_kv_rows_prefill(pos0, pad_t)
+            logits_row = logits[:, real - 1, :]
+            start += real
+        return logits_row
+
+    def _account_kv_rows_prefill(self, pos0: int, t: int) -> None:
+        """Pool rows one prefill chunk's attention read (same
+        units/contract as the decode-tick accounting): a B=1
+        multi-token step whose deepest query row attends at
+        pos0 + t - 1. Everything here derives from max_len (MB) and
+        the chunk's live span — NEVER from pool size, the property
+        the chunked-prefill acceptance test pins."""
+        bs = self.bs
+        baseline = self.MB * bs
+        if self.attention == "gathered":
+            rows_read = baseline
+        elif self.attention == "blockwise":
+            rows_read = ((pos0 + t - 1) // bs + 1) * bs
+        else:  # pallas
+            win = self.dec.cfg.window
+            hi = (pos0 + t - 1) // bs
+            lo = max(pos0 - win + 1, 0) // bs if win is not None else 0
+            rows_read = (hi - lo + 1) * bs
+        self.obs.kv_rows_read.inc(rows_read)
+        self.obs.kv_rows_gathered.inc(baseline)
+        self.obs.kv_rows_last.set(rows_read)
+
     def _admit_radix(
         self, i, rid, prompt, steps, adapter_id, samp, stop_seqs
     ) -> bool:
@@ -1319,41 +1730,60 @@ class PagedDecodeServer:
         # first generated token (its K/V row is rewritten with
         # identical content).
         suffix_pos = min(len(hits) * bs, t0 - 1)
-        if hits:
-            gk, gv = self._gather(
-                self.pool_k, self.pool_v, jnp.asarray(table_row)
-            )
-            small = {
-                "k": gk,
-                "v": gv,
-                "pos": jnp.asarray(suffix_pos, jnp.int32),
-            }
-        else:
-            small = self.dec.init_cache(1)
         suffix = prompt[:, suffix_pos:]
         ts = suffix.shape[1]
         self.obs.prefill_tokens.inc(ts)
-        pad = 1 << (ts - 1).bit_length()
-        pad = min(pad, self.dec.cfg.max_len - suffix_pos)
-        padded = jnp.concatenate(
-            [suffix, jnp.zeros((1, pad - ts), prompt.dtype)], axis=1
-        )
-        logits, small = self.dec.make_step()(
-            self.params, small, padded
-        )
-        # Dynamic-skip insert: hit blocks are never rewritten (their
-        # recomputed rows are equivalent but not guaranteed
-        # bit-identical, and they belong to every other holder of the
-        # chain); fresh rows land in this request's blocks; unowned
-        # tail entries point at trash by the module invariant.
-        self.pool_k, self.pool_v = self._insert_dyn(
-            self.pool_k,
-            self.pool_v,
-            small["k"],
-            small["v"],
-            jnp.asarray(table_row),
-            jnp.asarray(len(hits), jnp.int32),
-        )
+        if self.prefill_chunk is not None:
+            # Pool-native chunked prefill: the hit blocks are read
+            # straight from the pool by the block-table attention (no
+            # gather into a flat lane), fresh rows scatter into this
+            # request's blocks as each chunk computes, and writes
+            # below keep_from (HIT rows, other holders' memory)
+            # redirect to trash — the dynamic-skip rule, applied per
+            # row instead of per block.
+            logits_row = self._prefill_paged(
+                suffix,
+                table_row,
+                base=suffix_pos,
+                keep_from=len(hits) * bs,
+                adapter_id=adapter_id,
+            )
+        else:
+            if hits:
+                gk, gv = self._gather(
+                    self.pool_k, self.pool_v, jnp.asarray(table_row)
+                )
+                small = {
+                    "k": gk,
+                    "v": gv,
+                    "pos": jnp.asarray(suffix_pos, jnp.int32),
+                }
+            else:
+                small = self.dec.init_cache(1)
+            pad = 1 << (ts - 1).bit_length()
+            pad = min(pad, self.dec.cfg.max_len - suffix_pos)
+            padded = jnp.concatenate(
+                [suffix, jnp.zeros((1, pad - ts), prompt.dtype)],
+                axis=1,
+            )
+            logits, small = self.dec.make_step()(
+                self.params, small, padded
+            )
+            # Dynamic-skip insert: hit blocks are never rewritten
+            # (their recomputed rows are equivalent but not guaranteed
+            # bit-identical, and they belong to every other holder of
+            # the chain); fresh rows land in this request's blocks;
+            # unowned tail entries point at trash by the module
+            # invariant.
+            self.pool_k, self.pool_v = self._insert_dyn(
+                self.pool_k,
+                self.pool_v,
+                small["k"],
+                small["v"],
+                jnp.asarray(table_row),
+                jnp.asarray(len(hits), jnp.int32),
+            )
+            logits_row = logits[:, ts - 1, :]
         for j in range(len(hits), n_full):
             displaced = self.radix.register(
                 keys[j], toks[j], int(table_row[j])
@@ -1365,7 +1795,7 @@ class PagedDecodeServer:
         self.prefill_tokens_saved += suffix_pos
         self.blocks_peak = max(self.blocks_peak, self.blocks_in_use)
         first = self._sampler.admit_first(
-            i, samp, logits[:, ts - 1, :], prompt.dtype
+            i, samp, logits_row, prompt.dtype
         )
         self.tables[i] = table_row
         self.pos[i] = t0
@@ -1381,6 +1811,13 @@ class PagedDecodeServer:
             "stop": matcher_or_none(stop_seqs),
         }
         self.slots[i] = slot
+        if self._draft is not None and not slot["sampling"]:
+            # Seed speculation: the first token anchors the pend list
+            # (it is emitted but not yet in any K/V), and the draft
+            # lane prefills the FULL prompt — radix hits are a pool
+            # concept the draft does not share.
+            slot["pend"] = [int(first[0, 0])]
+            self._draft.admit(i, prompt)
         self._feed = self._feed.at[i].set(first[0].astype(jnp.int32))
         self.obs.ttft.observe(
             time.perf_counter()
@@ -1594,44 +2031,64 @@ class PagedDecodeServer:
             self.blocks_peak = max(
                 self.blocks_peak, self.blocks_in_use + need
             )
-            # Contiguous prefill through the flat decoder — pow2
-            # bucketed like the flat server, so the compiled prefill
-            # shape set stays tiny — then page the rows in. With a
-            # shared prefix the suffix prefills at offset P on a COPY
-            # of the contiguous prefix lane (the flat step donates its
-            # cache), and only rows past the shared blocks are paged.
-            pad = 1 << (t0 - 1).bit_length()
-            pad = min(pad, self.dec.cfg.max_len - P)
-            padded = jnp.concatenate(
-                [prompt, jnp.zeros((1, pad - t0), prompt.dtype)], axis=1
-            )
-            # Non-donating prefill step: the master prefix lane is
-            # read directly (no per-admission deep copy of two full
-            # max_len K/V buffers — the cost this feature exists to
-            # avoid); the returned cache is a fresh tree.
-            if self._prefix_cache is None:
-                small = self.dec.init_cache(1)
-            else:
-                small = dict(self._prefix_cache)
-            if self.multi_lora:
-                small["adapter"] = jnp.full((1,), adapter_id, jnp.int32)
-            logits, small = self.dec.make_step(donate=False)(
-                self.params, small, padded
-            )
             table_row = np.zeros((self.MB,), np.int32)
             for j, blk in enumerate(self.shared_blocks):
                 table_row[j] = blk
             for j, blk in enumerate(blocks):
                 table_row[n_shared + j] = blk
-            self.pool_k, self.pool_v = self._insert(
-                self.pool_k,
-                self.pool_v,
-                small["k"],
-                small["v"],
-                jnp.asarray(table_row),
-            )
+            if self.prefill_chunk is not None:
+                # Pool-native chunked prefill: rows land in the
+                # allocated blocks as each chunk computes, and a
+                # global shared prefix (base=P) is read from ITS pool
+                # blocks by the block-table attention — no contiguous
+                # prefix lane, no insert pass.
+                logits_row = self._prefill_paged(
+                    prompt,
+                    table_row,
+                    base=P,
+                    keep_from=0,
+                    adapter_id=adapter_id,
+                )
+            else:
+                # Contiguous prefill through the flat decoder — pow2
+                # bucketed like the flat server, so the compiled
+                # prefill shape set stays tiny — then page the rows
+                # in. With a shared prefix the suffix prefills at
+                # offset P on a COPY of the contiguous prefix lane
+                # (the flat step donates its cache), and only rows
+                # past the shared blocks are paged.
+                pad = 1 << (t0 - 1).bit_length()
+                pad = min(pad, self.dec.cfg.max_len - P)
+                padded = jnp.concatenate(
+                    [prompt, jnp.zeros((1, pad - t0), prompt.dtype)],
+                    axis=1,
+                )
+                # Non-donating prefill step: the master prefix lane is
+                # read directly (no per-admission deep copy of two
+                # full max_len K/V buffers — the cost this feature
+                # exists to avoid); the returned cache is a fresh
+                # tree.
+                if self._prefix_cache is None:
+                    small = self.dec.init_cache(1)
+                else:
+                    small = dict(self._prefix_cache)
+                if self.multi_lora:
+                    small["adapter"] = jnp.full(
+                        (1,), adapter_id, jnp.int32
+                    )
+                logits, small = self.dec.make_step(donate=False)(
+                    self.params, small, padded
+                )
+                self.pool_k, self.pool_v = self._insert(
+                    self.pool_k,
+                    self.pool_v,
+                    small["k"],
+                    small["v"],
+                    jnp.asarray(table_row),
+                )
+                logits_row = logits[:, t0 - 1, :]
             first = self._sampler.admit_first(
-                i, samp, logits[:, t0 - 1, :], prompt.dtype
+                i, samp, logits_row, prompt.dtype
             )
             self.tables[i] = table_row
             self.pos[i] = P + t0
@@ -1646,6 +2103,13 @@ class PagedDecodeServer:
                 "stop": matcher_or_none(stop_seqs),
             }
             self.slots[i] = slot
+            if self._draft is not None and not slot["sampling"]:
+                # The first generated token is the slot's initial
+                # pending feed; the draft lane prefills the FULL
+                # prompt (admission-time host read — not a hot-loop
+                # sync, _admit is outside the analysis hot set).
+                slot["pend"] = [int(first[0, 0])]
+                self._draft.admit(i, prompt)
             self._feed = self._feed.at[i].set(
                 first[0].astype(jnp.int32)
             )
@@ -1667,6 +2131,8 @@ class PagedDecodeServer:
             )
 
     def _tick(self) -> None:
+        if self.spec_k:
+            return self._tick_spec()
         if self.decode_window > 1:
             return self._tick_window()
         live = [s is not None for s in self.slots]
@@ -1760,6 +2226,232 @@ class PagedDecodeServer:
             self._emit_token(
                 i, slot, int(host_nxt[i]) if host_nxt is not None else None
             )
+
+    def _tick_spec(self) -> None:
+        """One speculative round: TWO host dispatches advance every
+        greedy slot up to spec_k + 1 tokens (ARCHITECTURE.md
+        "Speculative serving" has the full semantics).
+
+        1. DRAFT PROPOSE (DraftLanes.propose, one fused program):
+           each greedy slot's lane catches up on its 1-2 pending
+           committed tokens, then emits k greedy proposals.
+        2. TARGET VERIFY (_mt_body, T = k + 1): row 0 is the slot's
+           feed token, rows 1..k the proposals; all k + 1 candidate
+           K/V rows scatter into the slot's pool blocks in the same
+           dispatch (sampled slots keep row 0 only, idle slots none —
+           trash-redirected dead writes).
+        3. ONE batched host transfer of (preds, props[, sampled
+           draws]) feeds the accept test (batching.accept_lengths):
+           slot i emits props[:a] plus the target's own token at the
+           first mismatch (or the bonus row on full accept) — the
+           greedy chain is the target's chain, token for token, so
+           output is bit-identical to spec_k=0. Rejected rows sit
+           stale behind the position mask; the next round's verify
+           span rewrites them before they can ever be read.
+
+        Sampled slots advance exactly ONE token per round, drawn from
+        the verify forward's row 0 through the shared SlotSampler —
+        one draw call per round, same as one draw per tick at
+        spec_k=0, so sampled streams are bit-identical too."""
+        live = [s is not None for s in self.slots]
+        if not any(live):
+            return
+        self._build()
+        k = self.spec_k
+        mt = self._ensure_mt()
+        # Per-slot draft-round inputs. pend = tokens emitted but not
+        # yet in the draft lane (1 after a partial accept, 2 after a
+        # full accept — the k-th proposal is never self-consumed, and
+        # the bonus token never proposed); the lane's write head is
+        # pos + 1 - len(pend) by that definition. Idle and sampled
+        # rows pin to 0, the idle-lane idiom, so their dead writes
+        # stay bounded and every live lane is re-fed from host truth.
+        feed2 = np.zeros((self.B, 2), np.int32)
+        adv = np.zeros((self.B,), np.int32)
+        dposm = np.zeros((self.B,), np.int32)
+        for i, slot in enumerate(self.slots):
+            if slot is None or slot["sampling"]:
+                continue
+            pend = slot["pend"]
+            adv[i] = len(pend)
+            feed2[i, 0] = pend[0]
+            feed2[i, 1] = pend[-1]  # len-1 pend feeds its token twice
+            dposm[i] = self.pos[i] + 1 - len(pend)
+        props = self._draft.propose(k, dposm, feed2, adv)  # [B, k]
+        # Verify all k+1 positions in ONE block-table forward: row 0
+        # re-derives each slot's next token from its feed (the greedy
+        # correctness anchor), rows 1..k check the proposals.
+        verify_in = jnp.concatenate(
+            [self._feed, props.astype(jnp.int32)], axis=1
+        )
+        n_keep = np.zeros((self.B,), np.int32)
+        for i, slot in enumerate(self.slots):
+            if slot is not None:
+                n_keep[i] = 1 if slot["sampling"] else k + 1
+        posm = np.where(live, self.pos, 0).astype(np.int32)
+        # Same aliasing-copy rule as the K=1 tick: tables/adapter are
+        # host-mutated by finish/admission while the dispatched verify
+        # may still be reading them.
+        logits, self.pool_k, self.pool_v = mt(
+            self.params,
+            self.pool_k,
+            self.pool_v,
+            jnp.asarray(self.tables.copy()),
+            jnp.asarray(posm),
+            verify_in,
+            jnp.asarray(n_keep),
+            jnp.zeros((self.B,), jnp.int32),
+            jnp.asarray(self.adapter.copy()),
+        )
+        preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        any_sampling = any(
+            s is not None and s["sampling"] for s in self.slots
+        )
+        draw = (
+            self._sampler.draw(logits[:, 0, :]) if any_sampling else None
+        )
+        self.ticks += 1
+        self.dispatches += 2
+        n_live = sum(live)
+        now = time.perf_counter()
+        if self._last_tick_t is not None:
+            self.obs.itl.observe(now - self._last_tick_t, n_live)
+        self._last_tick_t = now
+        self.obs.ticks.inc()
+        self.obs.host_dispatches.inc(2)
+        # Pool rows the verify forward read (same units/contract as
+        # the K=1 tick; the draft reads its own flat lanes, not the
+        # pool). The deepest query row of slot i attends at pos + k.
+        baseline = self.B * self.MB * self.bs
+        if self.attention == "gathered":
+            rows_read = baseline
+        elif self.attention == "blockwise":
+            rows_read = (
+                self.B
+                * ((int(posm.max()) + k) // self.bs + 1)
+                * self.bs
+            )
+        else:  # pallas
+            win = self.dec.cfg.window
+            hi = (posm + k) // self.bs
+            lo = (
+                np.maximum(posm - win + 1, 0) // self.bs
+                if win is not None
+                else np.zeros_like(posm)
+            )
+            rows_read = int(np.sum(hi - lo + 1)) * self.bs
+        self.obs.kv_rows_read.inc(rows_read)
+        self.obs.kv_rows_gathered.inc(baseline)
+        self.obs.kv_rows_last.set(rows_read)
+        # analysis: ignore[host-sync-in-hot-loop] the ONE batched
+        # accept-test transfer per speculative ROUND — up to k+1
+        # tokens per slot amortize it, the sync the round is designed
+        # around (spec_accept fixtures pin the shape)
+        preds_host = np.asarray(preds)
+        # analysis: ignore[host-sync-in-hot-loop] proposal half of the
+        # same batched round transfer (ready with the verify above)
+        props_host = np.asarray(props)
+        if draw is not None:
+            # analysis: ignore[host-sync-in-hot-loop] sampled rows'
+            # slice of the same per-round sync point
+            draw_host = np.asarray(draw)
+        a_vec = accept_lengths(props_host, preds_host[:, :k])
+        proposed = 0
+        accepted_draft = 0
+        accepted = [0] * self.B
+        finishing = [False] * self.B
+        toks_host: list[list[int] | None] = [None] * self.B
+        feedv = np.zeros((self.B,), np.int32)
+        for i, slot in enumerate(self.slots):
+            if slot is None:
+                continue
+            if slot["sampling"]:
+                emitted = [int(draw_host[i])]
+            else:
+                # analysis: ignore[host-sync-in-hot-loop] a_vec is
+                # host numpy (accept_lengths of the batched fetch)
+                a = int(a_vec[i])
+                proposed += k
+                accepted_draft += a
+                emitted = [int(t) for t in props_host[i, :a]]
+                emitted.append(int(preds_host[i, a]))
+            # Per-token drain, K=1-equivalent: budget, then eos, then
+            # stop — the first terminator wins and everything after it
+            # is discarded (a truncated slot always finishes, so the
+            # continuing-slot feed/pend math below never sees a cut).
+            room = slot["remaining"]
+            kept = 0
+            stopped = False
+            for tok in emitted:
+                if kept >= room:
+                    break
+                kept += 1
+                if self.eos_id is not None and tok == self.eos_id:
+                    stopped = True
+                    break
+                if slot["stop"] is not None and slot["stop"].push(tok):
+                    stopped = True
+                    break
+            if kept < len(emitted):
+                self.obs.window_truncated.inc()
+            slot["remaining"] -= kept
+            if stopped:
+                slot["remaining"] = 0
+            # analysis: ignore[host-sync-in-hot-loop] emitted is a
+            # host int list — this UPLOADS the kept tokens, no fetch
+            kept_arr = np.asarray(emitted[:kept], np.int32)[None, :]
+            tok_block = jnp.asarray(kept_arr).astype(
+                slot["last"].dtype
+            )
+            slot["toks"].append(tok_block)
+            slot["last"] = tok_block[:, -1:]
+            self.pos[i] += kept
+            accepted[i] = kept
+            toks_host[i] = emitted[:kept]
+            finishing[i] = slot["remaining"] == 0
+            self.obs.tokens_generated.inc(kept)
+            self.window_tokens += kept
+            feedv[i] = emitted[-1]
+            if not slot["sampling"] and not finishing[i]:
+                # kept == a + 1 here (truncation implies finish):
+                # partial accept leaves only the correction token
+                # pending; full accept also leaves the never-consumed
+                # k-th proposal.
+                if a < k:
+                    slot["pend"] = [emitted[-1]]
+                else:
+                    slot["pend"] = [
+                        int(props_host[i, k - 1]), emitted[-1],
+                    ]
+                self._draft.pos[i] = (
+                    self.pos[i] + 1 - len(slot["pend"])
+                )
+        self._feed = jnp.asarray(feedv[:, None])
+        self.spec_rounds_n += 1
+        self.spec_proposed_n += proposed
+        self.spec_accepted_n += accepted_draft
+        self.obs.spec_rounds.inc()
+        if proposed:
+            self.obs.spec_proposed.inc(proposed)
+        if accepted_draft:
+            self.obs.spec_accepted.inc(accepted_draft)
+        if self.spec_proposed_n:
+            self.obs.spec_acceptance.set(
+                self.spec_accepted_n / self.spec_proposed_n
+            )
+        # Mean per-dispatch yield: a round is two dispatches.
+        self.obs.tokens_per_dispatch.set(float(sum(accepted)) / 2.0)
+        if self.on_token is not None:
+            for t, i in window_drain_order(accepted, k + 1):
+                slot = self.slots[i]
+                self.on_token(
+                    slot["rid"],
+                    toks_host[i][t],
+                    finishing[i] and t == accepted[i] - 1,
+                )
+        for i in range(self.B):
+            if finishing[i]:
+                self._finish(i)
 
     def _tick_window(self) -> None:
         """One fused dispatch of up to decode_window tokens per live
@@ -1970,6 +2662,8 @@ class PagedDecodeServer:
         self.pos[i] = 0
         self.adapter[i] = 0
         self.slots[i] = None
+        if self._draft is not None:
+            self._draft.release(i)
         # Release the slot's sampling policy row NOW, not at reuse —
         # a lingering row_sort would drag every later tick through the
         # sorting sampler (decode_server.SlotSampler.release).
@@ -1992,6 +2686,10 @@ def serve_paged(
     sampling: list | None = None,
     attention: str = "gathered",
     decode_window: int = 1,
+    spec_draft: Any = None,
+    spec_params: dict | None = None,
+    spec_k: int = 0,
+    prefill_chunk: int | None = None,
 ) -> tuple[list[jax.Array], dict]:
     """One-shot paged serving; returns (outputs in submission order,
     stats incl. peak pool usage). `adapter_ids` optionally assigns a
@@ -2005,7 +2703,14 @@ def serve_paged(
     token-identical to the default K=1. Stats then also carry
     `decode_window`, `host_dispatches` (decode dispatches issued) and
     `tokens_per_dispatch` (mean tokens accepted per dispatch — the
-    dispatch-amortization win, approaching K * live slots)."""
+    dispatch-amortization win, approaching K * live slots).
+
+    `spec_k=k` with `spec_draft`/`spec_params` turns on paged
+    speculative decoding (PagedDecodeServer docstring): greedy
+    outputs stay token-identical to `spec_k=0`; stats then also carry
+    `spec_rounds` / `spec_proposed` / `spec_accepted` /
+    `spec_acceptance`. `prefill_chunk=C` switches admission to the
+    pool-native chunked prefill path."""
     srv = PagedDecodeServer(
         dec,
         params,
@@ -2017,6 +2722,10 @@ def serve_paged(
         prefix_cache=prefix_cache,
         attention=attention,
         decode_window=decode_window,
+        spec_draft=spec_draft,
+        spec_params=spec_params,
+        spec_k=spec_k,
+        prefill_chunk=prefill_chunk,
     )
     aids = adapter_ids or [0] * len(requests)
     if len(aids) != len(requests):
@@ -2053,5 +2762,15 @@ def serve_paged(
         tokens_per_dispatch=(
             srv.window_tokens / srv.dispatches if srv.dispatches else 0.0
         ),
+        spec_k=srv.spec_k,
+        spec_rounds=srv.spec_rounds_n,
+        spec_proposed=srv.spec_proposed_n,
+        spec_accepted=srv.spec_accepted_n,
+        spec_acceptance=(
+            srv.spec_accepted_n / srv.spec_proposed_n
+            if srv.spec_proposed_n
+            else 0.0
+        ),
+        prefill_chunk=srv.prefill_chunk,
     )
     return [done[r] for r in rids], stats

@@ -21,7 +21,12 @@ deadline machinery, so a wedged compile cannot sink the headline.
 Also here: `run_window_sweep(devices) -> dict` (`--window-sweep` on
 the CLI) — the fused-decode-window sweep (decode_window = K in
 {1,4,8,16}) pricing host dispatches per token against tokens/sec;
-bench.py runs it as the "decode_window" extras section.
+bench.py runs it as the "decode_window" extras section. And
+`run_spec_sweep(devices) -> dict` (`--spec-sweep`) — the paged
+speculative-decoding sweep (spec_k in {0,2,4}, self-draft so
+acceptance is 1.0) pricing tokens/sec, acceptance and
+dispatches-per-token per k; bench.py runs it as the "speculative"
+extras section.
 
 "pallas" is excluded by default off-TPU: the interpret-mode kernel is
 functionally identical but interpreter-slow, which would price the
@@ -271,6 +276,131 @@ def run_window_sweep(
     return out
 
 
+def run_spec_sweep(
+    devices=None,
+    *,
+    ks: tuple = (0, 2, 4),
+    num_layers: int = 2,
+    dim: int = 64,
+    num_heads: int = 4,
+    num_kv_heads: int = 2,
+    vocab_size: int = 512,
+    max_len: int = 256,
+    num_blocks: int = 49,
+    block_size: int = 16,
+    max_batch: int = 4,
+    num_requests: int = 8,
+) -> dict:
+    """Paged speculative-decoding sweep: the same fixed request mix
+    served at spec_k = k for each k (0 = the classic tick loop, the
+    baseline). Returns {config, ks: {k: {tokens_per_sec, acceptance,
+    spec_rounds, host_dispatches, dispatches_per_token,
+    speedup_vs_k0}}}.
+
+    The draft IS the target (self-draft): every proposal matches the
+    target's own argmax, acceptance sits at 1.0, and each two-dispatch
+    round commits k+1 tokens per slot — the sweep isolates the
+    DISPATCH-AMORTIZATION term of speculation (what k buys when the
+    draft is perfect), which is exactly the term that shows up off-TPU
+    where per-dispatch overhead dominates small-model decode. A real
+    deployment's draft is smaller and pays acceptance < 1; the
+    `acceptance` field is reported so the same sweep prices that too
+    (swap the draft in the caller).
+
+    Defaults are deliberately SMALLER than the other sweeps': a
+    self-draft doubles model compute per token, so speculation only
+    pays where per-dispatch overhead dominates compute — the regime
+    small drafts / big targets occupy on real hardware, emulated here
+    by shrinking the model rather than the draft (random tiny drafts
+    have ~0 acceptance against an unrelated target, which would
+    measure nothing)."""
+    import jax
+    import jax.numpy as jnp
+
+    from defer_tpu.models.gpt import GptDecoder
+    from defer_tpu.models.llama import llama_config
+    from defer_tpu.runtime.paged import serve_paged
+
+    cfg = llama_config(
+        num_layers=num_layers,
+        dim=dim,
+        num_heads=num_heads,
+        num_kv_heads=num_kv_heads,
+        ffn_dim=dim * 2,
+        vocab_size=vocab_size,
+        max_len=max_len,
+    )
+    dec = GptDecoder(cfg, compute_dtype=jnp.bfloat16)
+    params = dec.cast_params(dec.init(jax.random.key(0)))
+    if devices:
+        params = jax.device_put(params, devices[0])
+    reqs = []
+    for i in range(num_requests):
+        t0 = 16 + (i * 23) % 112
+        steps = 16 + (i * 11) % 48
+        prompt = jax.random.randint(
+            jax.random.fold_in(jax.random.key(1), i),
+            (1, t0),
+            0,
+            cfg.vocab_size,
+        )
+        reqs.append((prompt, steps))
+    total_tokens = sum(s for _, s in reqs)
+    out: dict = {
+        "config": {
+            "num_layers": num_layers,
+            "dim": dim,
+            "heads": f"{num_heads}/{num_kv_heads}kv",
+            "max_len": max_len,
+            "num_blocks": num_blocks,
+            "block_size": block_size,
+            "max_batch": max_batch,
+            "requests": num_requests,
+            "total_tokens": total_tokens,
+            "draft": "self",
+        },
+        "ks": {},
+    }
+    base_tps = None
+    for k in ks:
+        spec = (
+            dict(spec_draft=dec, spec_params=params, spec_k=k)
+            if k
+            else {}
+        )
+
+        def run():
+            t0 = time.perf_counter()
+            outs, stats = serve_paged(
+                dec,
+                params,
+                reqs,
+                num_blocks=num_blocks,
+                block_size=block_size,
+                max_batch=max_batch,
+                **spec,
+            )
+            jax.block_until_ready(outs[-1])
+            return time.perf_counter() - t0, stats
+
+        run()  # compile pass
+        dt, stats = run()
+        tps = total_tokens / dt
+        if base_tps is None:
+            base_tps = tps
+        out["ks"][k] = {
+            "tokens_per_sec": round(tps, 1),
+            "acceptance": round(stats["spec_acceptance"], 4),
+            "spec_rounds": stats["spec_rounds"],
+            "host_dispatches": stats["host_dispatches"],
+            "dispatches_per_token": round(
+                stats["host_dispatches"] / total_tokens, 4
+            ),
+            "speedup_vs_k0": round(tps / base_tps, 3),
+        }
+    return out
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(
         description="paged-decode attention microbench (one JSON line)"
@@ -302,6 +432,18 @@ def main() -> None:
         default="1,4,8,16",
         help="comma-separated decode_window values for --window-sweep",
     )
+    ap.add_argument(
+        "--spec-sweep",
+        action="store_true",
+        help="run the paged speculative-decoding sweep (spec_k = "
+        "--spec-ks, self-draft) instead of the attention microbench",
+    )
+    ap.add_argument(
+        "--spec-ks",
+        default="0,2,4",
+        help="comma-separated spec_k values for --spec-sweep "
+        "(0 = non-speculative baseline)",
+    )
     args = ap.parse_args()
     shared = dict(
         num_layers=args.layers,
@@ -315,7 +457,30 @@ def main() -> None:
         max_batch=args.batch,
         num_requests=args.requests,
     )
-    if args.window_sweep:
+    if args.spec_sweep:
+        # Let run_spec_sweep's own (smaller) model defaults win unless
+        # the user explicitly overrode a flag: entries still at the
+        # parser default are dropped.
+        arg_of = {
+            "num_layers": "layers",
+            "dim": "dim",
+            "num_heads": "heads",
+            "num_kv_heads": "kv_heads",
+            "vocab_size": "vocab",
+            "max_len": "max_len",
+            "num_blocks": "blocks",
+            "block_size": "block_size",
+            "max_batch": "batch",
+            "num_requests": "requests",
+        }
+        shared = {
+            k: v
+            for k, v in shared.items()
+            if v != ap.get_default(arg_of[k])
+        }
+        ks = tuple(int(k) for k in args.spec_ks.split(",") if k)
+        rec = run_spec_sweep(ks=ks, **shared)
+    elif args.window_sweep:
         windows = tuple(
             int(k) for k in args.windows.split(",") if k
         )

@@ -1,0 +1,26 @@
+"""NEGATIVE: the accept-test shape the paged speculative round
+actually ships (runtime/paged.py::_tick_spec) — ONE batched transfer
+of the whole (props, preds) pair per round, justified in place, then
+pure host numpy for the per-slot accept lengths. Nothing else
+syncs."""
+
+import numpy as np
+
+
+class Server:
+    def _tick(self):
+        props, preds = self._round()
+        # analysis: ignore[host-sync-in-hot-loop] the ONE batched
+        # accept-test transfer per speculative round — up to k+1
+        # tokens per slot amortize it
+        preds_host = np.asarray(preds)
+        # analysis: ignore[host-sync-in-hot-loop] proposal half of the
+        # same batched round transfer
+        props_host = np.asarray(props)
+        mismatch = props_host != preds_host
+        first_bad = mismatch.argmax(axis=1)
+        a_vec = np.where(
+            mismatch.any(axis=1), first_bad, props_host.shape[1]
+        )
+        for i, slot in enumerate(self.slots):
+            slot.accept(a_vec[i])

@@ -1,0 +1,21 @@
+"""POSITIVE: a speculative accept test done WRONG — the host pulls
+the target's prediction and the draft's proposal one SCALAR at a time
+inside the per-slot loop, so a k-token round pays O(B * k) blocking
+device->host round trips instead of the one batched transfer the
+round is designed around (runtime/paged.py::_tick_spec)."""
+
+import numpy as np
+
+
+class Server:
+    def _tick(self):
+        props, preds = self._round()
+        for i, slot in enumerate(self.slots):
+            a = 0
+            for j in range(self.spec_k):
+                p = int(props[i, j])  # per-proposal scalar pull
+                t = np.asarray(preds[i, j])  # and another per token
+                if p != t:
+                    break
+                a += 1
+            slot.accept(a)

@@ -1,0 +1,328 @@
+"""Paged-native speculative decoding + pool-native chunked prefill.
+
+Correctness contract (runtime/paged.py `spec_k` docstring): greedy
+output is BIT-IDENTICAL to `serve_paged` at spec_k=0 — the verify
+forward's row 0 re-derives the target's own argmax chain, proposals
+only ever shorten the number of forwards, never change a token.
+Sampled slots ride the verify forward's first row through the same
+SlotSampler key stream as spec_k=0, so sampled streams match too.
+The chunked pool-native prefill path (`prefill_chunk`) must likewise
+be invisible in the tokens while its `defer_kv_rows_*` accounting
+scales with the prompt's live blocks, never with pool size.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from defer_tpu import obs
+from defer_tpu.models.gpt import SamplingParams, tiny_gpt
+from defer_tpu.runtime.paged import PagedDecodeServer, serve_paged
+
+
+@pytest.fixture(scope="module")
+def model():
+    dec = tiny_gpt(64)
+    params = dec.init(jax.random.key(0))
+    return dec, params
+
+
+@pytest.fixture(scope="module")
+def divergent_draft():
+    """Same architecture, different weights: proposals disagree with
+    the target almost immediately, driving acceptance toward 0 — the
+    rejection/rewrite path gets exercised every round."""
+    dec = tiny_gpt(64)
+    params = dec.init(jax.random.key(7))
+    return dec, params
+
+
+def _mixed_requests(vocab):
+    """Shared 8-token prefix on the first two (radix hits when
+    prefix_cache=True), lengths straddling block boundaries, one
+    single-token prompt."""
+    rng = np.random.default_rng(11)
+    base = jnp.asarray(rng.integers(1, vocab, size=(1, 8)), jnp.int32)
+    ext = jnp.asarray(rng.integers(1, vocab, size=(1, 3)), jnp.int32)
+    return [
+        (base, 6),
+        (jnp.concatenate([base, ext], axis=1), 5),
+        (jnp.asarray(rng.integers(1, vocab, size=(1, 1)), jnp.int32), 7),
+        (jnp.asarray(rng.integers(1, vocab, size=(1, 5)), jnp.int32), 4),
+    ]
+
+
+def _mixed_sampling():
+    """Two greedy slots, two sampled — speculative rounds must carry
+    both kinds at once (sampled rows keep only verify row 0)."""
+    return [
+        None,
+        SamplingParams(temperature=0.9, seed=13),
+        None,
+        SamplingParams(temperature=1.0, top_k=8, seed=5),
+    ]
+
+
+@pytest.fixture(scope="module")
+def baseline(model):
+    """spec_k=0 reference outputs, one per prefix_cache setting."""
+    dec, params = model
+    reqs = _mixed_requests(dec.cfg.vocab_size)
+    out = {}
+    for pc in (False, True):
+        outs, _ = serve_paged(
+            dec, params, reqs, num_blocks=24, block_size=8,
+            max_batch=2, sampling=_mixed_sampling(), prefix_cache=pc,
+        )
+        out[pc] = outs
+    return out
+
+
+@pytest.mark.parametrize("prefix_cache", [False, True])
+@pytest.mark.parametrize(
+    "attention", ["gathered", "blockwise", "pallas"]
+)
+@pytest.mark.parametrize("k", [2, 4])
+def test_spec_parity_matrix(model, baseline, k, attention, prefix_cache):
+    """The acceptance criterion: every k/attention/prefix_cache combo,
+    with greedy and sampled slots mixed in one batch, emits exactly
+    the spec_k=0 token streams (self-draft, so full-accept rounds and
+    the bonus-row path dominate)."""
+    dec, params = model
+    reqs = _mixed_requests(dec.cfg.vocab_size)
+    outs, stats = serve_paged(
+        dec, params, reqs, num_blocks=24, block_size=8, max_batch=2,
+        sampling=_mixed_sampling(), prefix_cache=prefix_cache,
+        attention=attention,
+        spec_draft=dec, spec_params=params, spec_k=k,
+    )
+    for want, got, (p, _) in zip(baseline[prefix_cache], outs, reqs):
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(want),
+            err_msg=(
+                f"k={k} attention={attention} prefix_cache="
+                f"{prefix_cache} prompt={np.asarray(p)}"
+            ),
+        )
+    assert stats["spec_k"] == k
+    assert stats["spec_rounds"] > 0
+
+
+def test_spec_rejections_still_match(model, divergent_draft, baseline):
+    """A draft that disagrees with the target (acceptance ~0) changes
+    only the round count, never a token: every rejected row is
+    replaced by the target's own choice."""
+    dec, params = model
+    draft, dparams = divergent_draft
+    reqs = _mixed_requests(dec.cfg.vocab_size)
+    outs, stats = serve_paged(
+        dec, params, reqs, num_blocks=24, block_size=8, max_batch=2,
+        sampling=_mixed_sampling(),
+        spec_draft=draft, spec_params=dparams, spec_k=3,
+    )
+    for want, got in zip(baseline[False], outs):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # Greedy slots proposed every round; a divergent tiny model
+    # rarely guesses the target's argmax, so acceptance sits low.
+    assert stats["spec_proposed"] > 0
+    assert stats["spec_acceptance"] < 0.5
+
+
+def test_spec_acceptance_stats_and_dispatch_amortization(model):
+    """Self-draft: every proposal accepted (acceptance == 1.0), so
+    each two-dispatch round commits k+1 tokens per greedy slot —
+    strictly fewer host dispatches than one-per-token serving. The
+    defer_spec_* counters must agree with the stats fields."""
+    dec, params = model
+    reqs = [(jnp.asarray([[3, 9, 27]], jnp.int32), 9)]
+    with obs.counter_deltas() as d:
+        outs, stats = serve_paged(
+            dec, params, reqs, num_blocks=16, block_size=8,
+            max_batch=2, spec_draft=dec, spec_params=params, spec_k=4,
+        )
+    assert stats["spec_acceptance"] == 1.0
+    assert stats["spec_accepted"] == stats["spec_proposed"] > 0
+    # 9 generated tokens: 1 at admission + 8 from ceil(8/5)=2 rounds.
+    assert stats["spec_rounds"] == 2
+    assert stats["host_dispatches"] == 2 * stats["spec_rounds"]
+    assert stats["host_dispatches"] < 8  # beats one dispatch/token
+    assert (
+        d.get('defer_spec_rounds_total{server="paged"}', 0)
+        == stats["spec_rounds"]
+    )
+    assert (
+        d.get('defer_spec_proposed_total{server="paged"}', 0)
+        == stats["spec_proposed"]
+    )
+    assert (
+        d.get('defer_spec_accepted_total{server="paged"}', 0)
+        == stats["spec_accepted"]
+    )
+
+
+def test_spec_eos_and_stop_mid_round(model):
+    """A terminator inside a speculative window truncates exactly
+    where the sequential loop stops: eos ends the output WITH the eos
+    token; a stop sequence ends it at the sequence's last token."""
+    dec, params = model
+    req = (jnp.asarray([[11, 2, 8, 1, 6]], jnp.int32), 9)
+    base, _ = serve_paged(
+        dec, params, [req], num_blocks=16, block_size=8, max_batch=1
+    )
+    toks = np.asarray(base[0])[0]
+    t0 = req[0].shape[1]
+    eos = int(toks[t0 + 3])  # 4th generated token
+    for kwargs in (
+        {"eos_id": eos},
+        {"stop": [[int(toks[t0 + 2]), int(toks[t0 + 3])]]},
+    ):
+        stop = kwargs.pop("stop", None)
+        srv_args = dict(
+            num_blocks=16, block_size=8, max_batch=1, **kwargs
+        )
+        want_srv = PagedDecodeServer(dec, params, **srv_args)
+        want_srv.submit(req[0], req[1], stop=stop)
+        want = list(want_srv.run().values())[0]
+        got_srv = PagedDecodeServer(
+            dec, params, spec_draft=dec, spec_params=params, spec_k=4,
+            **srv_args,
+        )
+        got_srv.submit(req[0], req[1], stop=stop)
+        got = list(got_srv.run().values())[0]
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        assert np.asarray(got).shape[1] < t0 + 9  # actually truncated
+
+
+def test_spec_constructor_and_submit_validation(model):
+    dec, params = model
+    base = dict(num_blocks=16, block_size=8, max_batch=2)
+    with pytest.raises(ValueError, match="spec_k must be >= 0"):
+        PagedDecodeServer(dec, params, spec_k=-1, **base)
+    with pytest.raises(ValueError, match="spec_k >= 1"):
+        PagedDecodeServer(dec, params, spec_draft=dec, **base)
+    with pytest.raises(ValueError, match="spec_draft and spec_params"):
+        PagedDecodeServer(dec, params, spec_k=2, **base)
+    with pytest.raises(ValueError, match="decode_window"):
+        PagedDecodeServer(
+            dec, params, spec_draft=dec, spec_params=params, spec_k=2,
+            decode_window=4, **base,
+        )
+    with pytest.raises(ValueError, match="prefix_ids"):
+        PagedDecodeServer(
+            dec, params, spec_draft=dec, spec_params=params, spec_k=2,
+            prefix_ids=jnp.zeros((1, 8), jnp.int32), **base,
+        )
+    small = tiny_gpt(32)
+    with pytest.raises(ValueError, match="max_len"):
+        PagedDecodeServer(
+            dec, params, spec_draft=small,
+            spec_params=small.init(jax.random.key(1)), spec_k=2, **base,
+        )
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        PagedDecodeServer(dec, params, prefill_chunk=0, **base)
+    srv = PagedDecodeServer(
+        dec, params, spec_draft=dec, spec_params=params, spec_k=4,
+        **base,
+    )
+    # Verify headroom: prompt + steps + spec_k must fit max_len.
+    with pytest.raises(ValueError, match="spec_k"):
+        srv.submit(jnp.zeros((1, 8), jnp.int32), 56)
+    with pytest.raises(ValueError, match="prefilled admission"):
+        srv.submit_prefilled(jnp.zeros((1, 8), jnp.int32), 4)
+
+
+@pytest.mark.parametrize(
+    "attention", ["gathered", "blockwise", "pallas"]
+)
+def test_chunked_prefill_parity(model, baseline, attention):
+    """prefill_chunk changes where prefill K/V is computed (straight
+    into pool blocks, chunk by chunk), not a single output token —
+    including radix-hit admissions that resume mid-prompt."""
+    dec, params = model
+    reqs = _mixed_requests(dec.cfg.vocab_size)
+    for pc in (False, True):
+        outs, stats = serve_paged(
+            dec, params, reqs, num_blocks=24, block_size=8,
+            max_batch=2, sampling=_mixed_sampling(), prefix_cache=pc,
+            attention=attention, prefill_chunk=3,
+        )
+        for want, got in zip(baseline[pc], outs):
+            np.testing.assert_array_equal(
+                np.asarray(got), np.asarray(want),
+                err_msg=f"attention={attention} prefix_cache={pc}",
+            )
+        assert stats["prefill_chunk"] == 3
+
+
+def test_chunked_prefill_rows_scale_with_blocks_not_pool(model):
+    """The prefill acceptance criterion on the obs counters: with
+    block-native attention, rows read during a chunked prefill derive
+    from the prompt's position span — growing the pool must not change
+    them, and they must undercut the gathered baseline. steps=1
+    requests finish at admission, so the deltas are pure prefill."""
+    dec, params = model
+    reqs = [
+        (jnp.asarray([[3, 9, 27, 4, 1, 8, 2, 6, 5, 7]], jnp.int32), 1),
+        (jnp.asarray([[5, 1, 2, 9]], jnp.int32), 1),
+    ]
+
+    def rows(attention, num_blocks):
+        with obs.counter_deltas() as d:
+            _, stats = serve_paged(
+                dec, params, reqs, num_blocks=num_blocks, block_size=4,
+                max_batch=2, attention=attention, prefill_chunk=4,
+            )
+        assert stats["ticks"] == 0  # admission-only: pure prefill
+        return (
+            d.get('defer_kv_rows_read_total{server="paged"}', 0),
+            d.get(
+                'defer_kv_rows_gathered_baseline_total{server="paged"}',
+                0,
+            ),
+        )
+
+    for attention in ("blockwise", "pallas"):
+        read_small, base_small = rows(attention, 18)
+        assert 0 < read_small < base_small
+        read_big, base_big = rows(attention, 40)
+        assert read_big == read_small  # pool size is invisible
+        assert base_big == base_small
+
+
+@pytest.mark.slow
+def test_paged_prefill_kernel_matches_blockwise_reference():
+    """Interpret-mode paged_flash_prefill vs the pure-XLA multi-token
+    fold on random pools and ragged start positions — same masking,
+    same block-table indirection, bitwise-comparable fp32 outputs
+    within kernel tolerance."""
+    from defer_tpu.ops.pallas_attention import paged_flash_prefill
+    from defer_tpu.runtime.paged import _blockwise_attend_mt
+
+    rng = np.random.default_rng(3)
+    B, Hq, Hkv, T, Dh, bs, MB, NB = 2, 4, 2, 5, 16, 8, 6, 11
+    q = jnp.asarray(
+        rng.standard_normal((B, Hq, T, Dh)), jnp.float32
+    )
+    pk = jnp.asarray(
+        rng.standard_normal((NB, Hkv, bs, Dh)), jnp.float32
+    )
+    pv = jnp.asarray(
+        rng.standard_normal((NB, Hkv, bs, Dh)), jnp.float32
+    )
+    tables = jnp.asarray(
+        rng.integers(1, NB, size=(B, MB)), jnp.int32
+    )
+    for start in ([0, 9], [3, 17], [26, 1]):
+        pos = jnp.asarray(start, jnp.int32)
+        got = paged_flash_prefill(
+            q, pk, pv, tables, pos, interpret=True
+        )  # [B, Hq, T, Dh]
+        want = _blockwise_attend_mt(
+            q, pk, pv, tables, pos, bs, MB, None
+        )  # [B, T, Hq*Dh]
+        got_flat = got.transpose(0, 2, 1, 3).reshape(B, T, Hq * Dh)
+        np.testing.assert_allclose(
+            np.asarray(got_flat), np.asarray(want),
+            rtol=2e-5, atol=2e-5, err_msg=f"start={start}",
+        )
