@@ -586,6 +586,29 @@ def bench_speculative(devices) -> dict:
     return rec
 
 
+def bench_tp_serving(devices) -> dict:
+    """Tensor-parallel paged serving (scripts/bench_paged.py): the
+    same request mix on a {"model": m} mesh for m in {1,2,4,8},
+    pricing tokens/sec and tokens-per-dispatch against per-shard KV
+    rows read. Host dispatches per token must not move with m; KV rows
+    per shard fall as 1/m — the mesh-labeled obs counters make both
+    exact."""
+    import importlib.util
+    import os
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "scripts",
+        "bench_paged.py",
+    )
+    spec = importlib.util.spec_from_file_location("bench_paged", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rec = mod.run_tp_sweep(devices)
+    log(f"tp serving sweep: {rec}")
+    return rec
+
+
 def bench_disagg(devices) -> dict:
     """Disaggregated serving (scripts/bench_disagg.py): the same
     request mix through monolithic serve_paged and split serve_disagg
@@ -863,6 +886,7 @@ def run_bench() -> dict:
         "paged_attention": None,
         "decode_window": None,
         "speculative": None,
+        "tp_serving": None,
         "disagg": None,
         "pallas_attention": None,
     }
@@ -1011,6 +1035,7 @@ def run_bench() -> dict:
             ("paged_attention", bench_paged_attention),
             ("decode_window", bench_decode_window),
             ("speculative", bench_speculative),
+            ("tp_serving", bench_tp_serving),
             ("disagg", bench_disagg),
             ("fleet", bench_fleet),
             ("bert_base", bench_bert),
@@ -1026,9 +1051,22 @@ def run_bench() -> dict:
 
         if _pallas_available():
             sections.append(("pallas_attention", bench_pallas_attention))
+        # Every section's JSON records where it ran: device kind from
+        # the live topology, mesh shape when the section itself swept
+        # one (tp_serving), else explicit null — so a perf number can
+        # never be read without its hardware context.
+        from defer_tpu.parallel.mesh import describe_topology
+
+        section_topo = describe_topology()
         for key, fn in sections:
             try:
-                result[key] = fn(devices)
+                rec = fn(devices)
+                if isinstance(rec, dict):
+                    rec.setdefault(
+                        "device_kind", section_topo["device_kind"]
+                    )
+                    rec.setdefault("mesh_shape", None)
+                result[key] = rec
             except Exception as e:  # noqa: BLE001 — extra datapoint only
                 log(f"{key} probe failed ({type(e).__name__}: {e})")
             snapshot(result)

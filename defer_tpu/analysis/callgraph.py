@@ -99,6 +99,30 @@ class _Collector(ast.NodeVisitor):
                     and f.attr not in _GENERIC_ATTRS
                 ):
                     info.calls_attr.add(f.attr)
+                # shard_map(body, mesh, ...) runs `body` per tick just
+                # as surely as body() would: link the wrapped function
+                # so the hot set flows THROUGH the wrapper into the
+                # sharded tick bodies. Keyed on the `shard_map` name
+                # alone — generic function-valued arguments (e.g.
+                # lax.scan bodies) must NOT create edges (the
+                # window_scan fixtures pin that).
+                callee = (
+                    f.id if isinstance(f, ast.Name)
+                    else f.attr if isinstance(f, ast.Attribute)
+                    else None
+                )
+                if callee == "shard_map":
+                    tgt = sub.args[0] if sub.args else next(
+                        (k.value for k in sub.keywords if k.arg == "f"),
+                        None,
+                    )
+                    if isinstance(tgt, ast.Name):
+                        info.calls_bare.add(tgt.id)
+                    elif (
+                        isinstance(tgt, ast.Attribute)
+                        and tgt.attr not in _GENERIC_ATTRS
+                    ):
+                        info.calls_attr.add(tgt.attr)
         self.out.append(info)
         self.stack.append(node.name)
         self.kinds.append("func")

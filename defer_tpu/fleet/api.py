@@ -16,6 +16,17 @@ Replica placement defaults to in-process threads; pass
 on_fail, on_dead)` returning a ThreadReplica-shaped object to place
 replicas elsewhere (the `spawn_worker=` pattern from disagg/api.py).
 
+Replica <-> devices contract: `make_server` takes the replica index
+and returns that replica's server ALREADY PLACED — the spawner never
+touches jax devices itself, it only decides where the thread/process
+runs. The in-process default partitions `jax.devices()` (or the
+`devices=` list) disjointly: replica i gets device `devs[i % len]`
+when `model_axis_size` is None, or the next `model_axis_size`-device
+slice as its own `{"model": m}` mesh (tensor-parallel serving,
+runtime/paged.py `mesh=`) — wrapping around when replicas outnumber
+device slices, so oversubscription shares devices rather than
+stacking every replica on device 0.
+
 Failure semantics: a dead replica fails its in-flight requests with
 `ReplicaDeadError` (their KV died with the pool — silently re-running
 them would hide a real outage), re-routes its still-queued requests to
@@ -76,6 +87,8 @@ class FleetFrontend:
         migrate: bool = True,
         migrate_gap: int = 4,
         spawn_replica: Any = None,
+        model_axis_size: int | None = None,
+        devices: list | None = None,
     ):
         if n_replicas < 1:
             raise ValueError(f"need >= 1 replica, got {n_replicas}")
@@ -108,7 +121,26 @@ class FleetFrontend:
         )
         self.alive = [True] * n_replicas
 
-        def make_server() -> PagedDecodeServer:
+        # Disjoint device partitioning (module docstring): replica i's
+        # placement comes from its index, wrap-around when replicas
+        # outnumber devices/slices. model_axis_size turns each replica
+        # into an m-chip tensor-parallel mesh.
+        devs = list(devices) if devices is not None else jax.devices()
+        if model_axis_size is not None and model_axis_size < 1:
+            raise ValueError(
+                f"model_axis_size must be >= 1, got {model_axis_size}"
+            )
+
+        def _placement(i: int) -> dict:
+            if model_axis_size is None:
+                return {"device": devs[i % len(devs)]}
+            from defer_tpu.parallel.mesh import make_mesh
+
+            m = model_axis_size
+            chunk = [devs[(i * m + j) % len(devs)] for j in range(m)]
+            return {"mesh": make_mesh({"model": m}, chunk)}
+
+        def make_server(i: int) -> PagedDecodeServer:
             return PagedDecodeServer(
                 dec,
                 params,
@@ -119,6 +151,7 @@ class FleetFrontend:
                 prefix_cache=prefix_cache,
                 attention=attention,
                 decode_window=decode_window,
+                **_placement(i),
             )
 
         spawn = spawn_replica or ThreadReplica
@@ -295,6 +328,7 @@ class FleetFrontend:
                         else 0
                     ),
                     prefill_tokens_saved=srv.prefill_tokens_saved,
+                    mesh_shape=srv.mesh_label,
                     dead=str(r.dead) if r.dead is not None else None,
                 )
             )
@@ -330,6 +364,8 @@ def serve_fleet(
     migrate: bool = True,
     migrate_gap: int = 4,
     spawn_replica: Any = None,
+    model_axis_size: int | None = None,
+    devices: list | None = None,
     result_timeout_s: float = 600.0,
 ) -> tuple[list[jax.Array], dict]:
     """One-shot fleet serving; same contract as `serve_paged` (outputs
@@ -338,7 +374,12 @@ def serve_fleet(
     nothing (unbounded queues, no SLO) — overload policy is opt-in via
     `slo_s`/`max_queue`, and a ShedError then propagates to the
     caller. Returns FleetStats: routing-reason and shed counts,
-    migrated block totals, and per-replica ServerStats."""
+    migrated block totals, and per-replica ServerStats.
+
+    Placement: replicas partition `jax.devices()` (or `devices=`)
+    disjointly, one device each by default; `model_axis_size=m` gives
+    each replica its own m-device "model" mesh and serves it
+    tensor-parallel (FleetFrontend docstring has the contract)."""
     fe = FleetFrontend(
         dec,
         params,
@@ -356,6 +397,8 @@ def serve_fleet(
         migrate=migrate,
         migrate_gap=migrate_gap,
         spawn_replica=spawn_replica,
+        model_axis_size=model_axis_size,
+        devices=devices,
     )
     samps = sampling or [None] * len(requests)
     stops = stop or [None] * len(requests)

@@ -57,7 +57,7 @@ class ThreadReplica:
     def __init__(
         self,
         idx: int,
-        make_server: Callable[[], PagedDecodeServer],
+        make_server: Callable[[int], PagedDecodeServer],
         controller: Any,
         board: Any,
         obs: Any,
@@ -67,7 +67,11 @@ class ThreadReplica:
         on_dead: Callable[[int, BaseException], None],
     ):
         self.idx = idx
-        self.srv = make_server()
+        # make_server(idx) returns this replica's server already
+        # placed on its device slice (fleet/api.py documents the
+        # replica <-> devices contract) — the spawner never picks
+        # devices itself.
+        self.srv = make_server(idx)
         self.controller = controller
         self.board = board
         self.obs = obs

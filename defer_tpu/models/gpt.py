@@ -1247,14 +1247,16 @@ class SpmdGptDecoder(GptDecoder):
     def make_step(self, *, donate: bool = True):
         from jax.sharding import PartitionSpec as P
 
+        from defer_tpu.utils.compat import shard_map
+
         vocab = self.cfg.vocab_size
 
         def build():
             cache_spec = self._cache_spec()
             dp = self.dp_axis
-            smapped = jax.shard_map(
+            smapped = shard_map(
                 self._step_fn(tp_axis=self.tp_axis),
-                mesh=self.mesh,
+                self.mesh,
                 in_specs=(self._specs(), cache_spec, P(dp, None)),
                 # Logits stay vocab-sharded inside; shard_map itself
                 # concatenates the [B/dp, T, Vpad/tp] slices.

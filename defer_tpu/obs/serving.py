@@ -28,11 +28,22 @@ class ServingMetrics:
     """Pre-bound instrument handles for one decode server flavour."""
 
     def __init__(
-        self, server: str, registry: MetricsRegistry | None = None
+        self,
+        server: str,
+        registry: MetricsRegistry | None = None,
+        mesh_shape: str | None = None,
     ):
         reg = registry if registry is not None else get_registry()
         self.registry = reg
         labels = {"server": server}
+        # Topology-auditable instruments additionally carry the mesh
+        # shape (e.g. "model=4") when the server runs tensor-parallel,
+        # so per-shard dispatch/bandwidth claims are separable from the
+        # single-device series; mesh_shape=None keeps the label set —
+        # and thus the exposition identity — exactly as before.
+        mesh_labels = dict(labels)
+        if mesh_shape is not None:
+            mesh_labels["mesh"] = mesh_shape
         self.requests_admitted = reg.counter(
             "defer_requests_admitted_total",
             "Requests admitted into a decode slot", labels,
@@ -111,7 +122,8 @@ class ServingMetrics:
             "defer_kv_rows_read_total",
             "KV cache rows (token positions, K+V pair = 1 unit, "
             "layer-agnostic) read by decode-tick attention, summed "
-            "over slots", labels,
+            "over slots; PER-SHARD under a mesh (each shard holds "
+            "kv_heads/TP heads, so reads scale as 1/TP)", mesh_labels,
         )
         self.kv_rows_gathered = reg.counter(
             "defer_kv_rows_gathered_baseline_total",
@@ -132,7 +144,15 @@ class ServingMetrics:
         self.host_dispatches = reg.counter(
             "defer_host_dispatches_total",
             "Decode-loop host dispatches (one per window; equals "
-            "decode ticks at decode_window=1)", labels,
+            "decode ticks at decode_window=1). Unchanged by tensor "
+            "parallelism — one dispatch drives all shards",
+            mesh_labels,
+        )
+        self.tp_psums = reg.counter(
+            "defer_tp_psum_total",
+            "Cross-shard collectives issued by sharded tick bodies "
+            "(2 per layer + embed psum + logits all-gather per "
+            "forward); zero on mesh=None", mesh_labels,
         )
         self.tokens_per_dispatch = reg.gauge(
             "defer_tokens_per_dispatch",

@@ -409,6 +409,9 @@ class PagedDecodeServer:
         spec_params: dict | None = None,
         spec_k: int = 0,
         prefill_chunk: int | None = None,
+        mesh: Any = None,
+        model_axis: str = "model",
+        device: Any = None,
     ):
         """`on_token(request_id, token_id, done)` — optional streaming
         callback, same contract as the flat server's.
@@ -460,6 +463,23 @@ class PagedDecodeServer:
         reads stop at the deepest live block, tie-tolerant), or
         "pallas" (block-table-indexed kernel, per-slot live-block
         DMA; interpret-mode fallback off-TPU, tie-tolerant).
+
+        `mesh` / `model_axis` — TENSOR-PARALLEL serving
+        (ARCHITECTURE.md "Sharded serving"): shard the decoder weights
+        (Megatron column/row split + vocab-sharded embedding) and the
+        paged KV pool's head axis over the mesh's `model_axis`, and run
+        every jitted tick body under shard_map so each device reads
+        only its local KV heads. Host-side mechanics (admission, block
+        tables, sampling, radix cache, obs) stay single-writer and
+        unsharded; sampling sees the replicated post-psum logits, so
+        per-window transfer and dispatch counts are unchanged.
+        mesh=None (default) is bit-identical to the single-device
+        server; a model_axis of size 1 is token-identical to it.
+
+        `device` — pin this server's params/pool (and hence every tick)
+        to one specific jax.Device instead of the process default —
+        how fleet replicas spread over a multi-chip host without
+        tensor parallelism. Mutually exclusive with `mesh`.
 
         `prefix_ids` [1, P] — SHARED-prefix paging: the system
         prompt's K/V blocks are allocated ONCE and every request's
@@ -533,6 +553,91 @@ class PagedDecodeServer:
             raise ValueError(
                 f"prefill_chunk must be >= 1, got {prefill_chunk}"
             )
+        if mesh is not None and device is not None:
+            raise ValueError(
+                "mesh= and device= are mutually exclusive: a mesh "
+                "already pins the server to its devices"
+            )
+        self.mesh = mesh
+        self.model_axis = model_axis
+        self.device = device
+        self.tp = 1
+        self._sdec = None
+        if mesh is not None:
+            if getattr(dec, "mesh", None) is not None:
+                raise ValueError(
+                    "pass the plain single-device decoder together "
+                    "with mesh= — the server builds its own sharded "
+                    "step (an SpmdGptDecoder here would double-wrap "
+                    "shard_map)"
+                )
+            if model_axis not in mesh.axis_names:
+                raise ValueError(
+                    f"model_axis {model_axis!r} is not an axis of the "
+                    f"mesh (axes: {mesh.axis_names}); build the mesh "
+                    f"with parallel.mesh.make_mesh({{{model_axis!r}: "
+                    "N})"
+                )
+            tp = int(mesh.shape[model_axis])
+            kvh = dec.cfg.kv_heads
+            if kvh < tp:
+                raise ValueError(
+                    f"GQA num_kv_heads={kvh} is smaller than the "
+                    f"{model_axis!r} axis size {tp}: the paged pool "
+                    "shards whole KV heads, so some devices would own "
+                    "none. Fix: serve on a mesh whose model axis has "
+                    f"at most {kvh} devices (put the rest on a data "
+                    "axis), or replicate KV heads in the checkpoint."
+                )
+            if kvh % tp:
+                fit = max(
+                    d for d in range(1, kvh + 1)
+                    if kvh % d == 0 and d <= tp
+                )
+                raise ValueError(
+                    f"num_kv_heads={kvh} does not divide by the "
+                    f"{model_axis!r} axis size {tp}: each device must "
+                    "own an equal whole-head slice of the paged pool. "
+                    f"Fix: use a model axis size that divides {kvh} "
+                    f"(largest that fits: {fit}), or pad kv_heads to "
+                    f"a multiple of {tp} in the checkpoint."
+                )
+            if self.multi_lora:
+                raise ValueError(
+                    "mesh= with multi-LoRA is unsupported: the adapter "
+                    "banks are not sharded — serve adapters on "
+                    "mesh=None"
+                )
+            self.tp = tp
+            # One sharded view of the decoder per (dec, mesh, axis):
+            # SpmdGptDecoder supplies the param specs, vocab padding,
+            # sharded flat prefill step, and the remaining divisibility
+            # validation (heads/dim/ffn % tp).
+            from defer_tpu.models.gpt import SpmdGptDecoder
+            from defer_tpu.utils.memo import cached_step
+
+            self._sdec = cached_step(
+                dec,
+                ("spmd_view", mesh, model_axis),
+                lambda: SpmdGptDecoder(
+                    dec.cfg,
+                    compute_dtype=dec.compute_dtype,
+                    mesh=mesh,
+                    tp_axis=model_axis,
+                ),
+            )
+        # Memo-key component for every compiled program: a mesh-built
+        # step and a single-device step must never share a cache slot
+        # on the same decoder instance.
+        self._mesh_key = (mesh, model_axis) if mesh is not None else None
+        self.mesh_label = f"{model_axis}={self.tp}" if mesh is not None else None
+        # Collectives one sharded forward issues: per layer an attn
+        # psum + an ffn psum, plus the embedding psum and the final
+        # logits all_gather. Host-side mirror for defer_tp_psum_total.
+        self._psums_per_fwd = (
+            2 * dec.cfg.num_layers + 2 if mesh is not None else 0
+        )
+        self.tp_psums = 0
         self.decode_window = decode_window
         self.attention = attention
         self.dec = dec
@@ -548,8 +653,33 @@ class PagedDecodeServer:
         pool_shape = (
             cfg.num_layers, num_blocks, cfg.kv_heads, block_size, dh,
         )
-        self.pool_k = jnp.zeros(pool_shape, dec.compute_dtype)
-        self.pool_v = jnp.zeros(pool_shape, dec.compute_dtype)
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as PSpec
+
+            # Pool sharded on the KV-head axis: each device holds
+            # [L, num_blocks, kv_heads/tp, block_size, Dh] — every
+            # block present on every shard, but only its local heads.
+            # Allocated DIRECTLY sharded (no transient replicated
+            # pool), params placed by the Megatron specs (vocab table
+            # padded to a tp multiple by shard_params).
+            self._pool_spec = PSpec(None, None, model_axis, None, None)
+            pool_sh = NamedSharding(mesh, self._pool_spec)
+            self.pool_k = jnp.zeros(
+                pool_shape, dec.compute_dtype, device=pool_sh
+            )
+            self.pool_v = jnp.zeros(
+                pool_shape, dec.compute_dtype, device=pool_sh
+            )
+            self.params = self._sdec.shard_params(params)
+        else:
+            self._pool_spec = None
+            self.pool_k = jnp.zeros(pool_shape, dec.compute_dtype)
+            self.pool_v = jnp.zeros(pool_shape, dec.compute_dtype)
+            if device is not None:
+                self.pool_k = jax.device_put(self.pool_k, device)
+                self.pool_v = jax.device_put(self.pool_v, device)
+                self.params = jax.device_put(params, device)
         # Block 0 is trash: unallocated table entries point at it.
         self.free = list(range(1, num_blocks))
         self.tables = np.zeros((max_batch, self.MB), np.int32)
@@ -583,7 +713,7 @@ class PagedDecodeServer:
         self.window_tokens = 0
         # Metric handles resolved once; tick/admission paths touch
         # pre-bound attributes only (obs/serving.py).
-        self.obs = ServingMetrics("paged")
+        self.obs = ServingMetrics("paged", mesh_shape=self.mesh_label)
         self._submit_t: dict[int, float] = {}
         self._last_tick_t: float | None = None
         self._step = None
@@ -659,11 +789,13 @@ class PagedDecodeServer:
 
             full_insert = cached_step(
                 dec,
-                ("paged_insert", block_size, 0),
+                ("paged_insert", block_size, 0, self._mesh_key),
                 lambda: self._build_insert(0),
             )
-            pre = dec.init_cache(1)
-            _, pre = dec.make_step()(params, pre, prefix_ids)
+            fdec = self._sdec if self._sdec is not None else dec
+            pre = fdec.init_cache(1)
+            _, pre = fdec.make_step()(self.params, pre, prefix_ids)
+            self._account_psums(1)
             self.shared_blocks = [
                 self.free.pop() for _ in range(n_shared)
             ]
@@ -949,6 +1081,25 @@ class PagedDecodeServer:
         v = np.asarray(self.pool_v[:, idx])
         return toks, k, v
 
+    def _shard_ingest(self, arr) -> jax.Array:
+        """Device placement for full-head host K/V entering the pool
+        (migration imports, disagg wire blobs, flat-lane inserts). On a
+        mesh the array is SPLIT ON ITS HEAD AXIS (index 2 — shared by
+        the [L, n, Hkv, bs, Dh] block-stack and [L, 1, Hkv, S, Dh]
+        lane layouts) as it lands on device, so each shard receives
+        only its local heads and the wire/lane format never changes.
+        On a pinned single device it lands there; otherwise this is
+        plain jnp.asarray."""
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding
+
+            return jax.device_put(
+                arr, NamedSharding(self.mesh, self._pool_spec)
+            )
+        if self.device is not None:
+            return jax.device_put(arr, self.device)
+        return jnp.asarray(arr)
+
     def _ensure_import(self):
         if self._import is None:
             from defer_tpu.utils.memo import cached_step
@@ -960,12 +1111,13 @@ class PagedDecodeServer:
                     # invariant.
                     pk = pk.at[:, dest].set(k_blocks)
                     pv = pv.at[:, dest].set(v_blocks)
-                    return pk, pv
+                    return self._pool_constraint(pk, pv)
 
                 return jax.jit(imp, donate_argnums=(0, 1))
 
             self._import = cached_step(
-                self.dec, ("fleet_import", self.bs), build
+                self.dec, ("fleet_import", self.bs, self._mesh_key),
+                build,
             )
         return self._import
 
@@ -1041,8 +1193,8 @@ class PagedDecodeServer:
         self.pool_k, self.pool_v = imp(
             self.pool_k,
             self.pool_v,
-            jnp.asarray(kb.astype(self.dec.compute_dtype)),
-            jnp.asarray(vb.astype(self.dec.compute_dtype)),
+            self._shard_ingest(kb.astype(self.dec.compute_dtype)),
+            self._shard_ingest(vb.astype(self.dec.compute_dtype)),
             jnp.asarray(dest),
         )
         for j, blk in enumerate(own):
@@ -1074,29 +1226,104 @@ class PagedDecodeServer:
         }
         self._step = cached_step(
             self.dec,
-            ("paged_step", self.bs, self.attention),
+            ("paged_step", self.bs, self.attention, self._mesh_key),
             builders[self.attention],
         )
         skip = len(self.shared_blocks)
         self._insert = cached_step(
             self.dec,
-            ("paged_insert", self.bs, skip),
+            ("paged_insert", self.bs, skip, self._mesh_key),
             lambda: self._build_insert(skip),
         )
         if self.radix is not None and self._gather is None:
             self._gather = cached_step(
                 self.dec,
-                ("paged_gather", self.bs),
+                ("paged_gather", self.bs, self._mesh_key),
                 self._build_gather,
             )
             self._insert_dyn = cached_step(
                 self.dec,
-                ("paged_insert_dyn", self.bs),
+                ("paged_insert_dyn", self.bs, self._mesh_key),
                 self._build_insert_dynamic,
             )
 
+    def _tp_axis(self):
+        """The tp_axis threaded into the tick bodies: the mesh's model
+        axis when serving sharded, None otherwise — with None every
+        body traces EXACTLY the single-device program (the mesh=None
+        bit-identity contract)."""
+        return self.model_axis if self.mesh is not None else None
+
+    def _flat_dec(self):
+        """The decoder whose contiguous-lane (flat) prefill programs
+        this server dispatches: on a mesh the memoized SpmdGptDecoder
+        view — its make_step/init_cache produce head-sharded lanes the
+        insert programs consume shard-local — otherwise the user's
+        decoder, unchanged."""
+        return self._sdec if self._sdec is not None else self.dec
+
+    def _account_kv_rows(self, rows_read: int, baseline: int) -> None:
+        """Publish one dispatch's KV-row traffic. On a mesh both
+        counters report PER-SHARD traffic: each device reads only its
+        kv_heads/tp local heads, so rows scale by 1/model-axis-size
+        (the counter-pinned TP contract; the read/baseline ratio still
+        isolates the blockwise/pallas win because both sides scale)."""
+        tp = self.tp
+        self.obs.kv_rows_read.inc(rows_read // tp)
+        self.obs.kv_rows_gathered.inc(baseline // tp)
+        self.obs.kv_rows_last.set(rows_read // tp)
+
+    def _account_psums(self, n_forwards: int) -> None:
+        """Count the cross-shard collectives `n_forwards` sharded
+        transformer forwards issue (per forward: attn + ffn psum per
+        layer, the embedding psum, the final-logits all_gather).
+        Host-side mirror of the traced program — no-op on mesh=None,
+        where no collective exists."""
+        if self._psums_per_fwd:
+            n = self._psums_per_fwd * n_forwards
+            self.tp_psums += n
+            self.obs.tp_psums.inc(n)
+
+    def _jit_tick(self, body, n_rep: int):
+        """jit one of the raw tick bodies `(params, pk, pv, *rest) ->
+        (out_tree..., pk, pv)`-shaped as `(logits, pk, pv)`. On a mesh
+        the body is wrapped in shard_map first: params by the Megatron
+        specs, the two pool operands on the KV-head axis, the `n_rep`
+        trailing host-fed operands (tables, positions, ids, ...)
+        replicated. Logits come back replicated — the body ends in a
+        tiled all_gather of the vocab-sharded slices — so sampling
+        stays on post-psum logits and check_rep must be off (the
+        checker cannot infer the gather's replication)."""
+        if self.mesh is None:
+            return jax.jit(body, donate_argnums=(1, 2))
+        from jax.sharding import PartitionSpec as PSpec
+
+        from defer_tpu.utils.compat import shard_map
+
+        pool, r = self._pool_spec, PSpec()
+        sm = shard_map(
+            body,
+            self.mesh,
+            in_specs=(self._sdec._specs(), pool, pool) + (r,) * n_rep,
+            out_specs=(r, pool, pool),
+            check_rep=False,
+        )
+        return jax.jit(sm, donate_argnums=(1, 2))
+
+    def _replicate_logits(self, logits):
+        """Inside a shard_map tick body: turn this shard's vocab slice
+        [B, T, Vpad/tp] into the full replicated [B, T, V] logits
+        (concatenate the slices, drop the vocab padding). Identity on
+        mesh=None."""
+        if self.mesh is None:
+            return logits
+        logits = lax.all_gather(
+            logits, self.model_axis, axis=-1, tiled=True
+        )
+        return logits[..., : self.dec.cfg.vocab_size]
+
     def _build_step(self):
-        return jax.jit(self._step_body(), donate_argnums=(1, 2))
+        return self._jit_tick(self._step_body(), n_rep=4)
 
     def _step_body(self):
         """The RAW (unjitted) gathered-attention step body — jitted
@@ -1104,10 +1331,11 @@ class PagedDecodeServer:
         the fused-window scan (_build_window) for decode_window > 1,
         so both paths run identical math by construction."""
         dec, bs = self.dec, self.bs
+        tp = self._tp_axis()
 
         def step(params, pk, pv, tables, pos, ids, adapter_ids):
             b = ids.shape[0]
-            x = dec._embed_tokens(params, ids, pos)
+            x = dec._embed_tokens(params, ids, pos, tp)
             rows = jnp.arange(b)
 
             def body(carry, layer):
@@ -1125,7 +1353,8 @@ class PagedDecodeServer:
                     b_, hkv, mb * bs, dh
                 )
                 out, kc, vc = dec._block(
-                    p, x, kc, vc, pos, adapter_ids=adapter_ids
+                    p, x, kc, vc, pos, tp_axis=tp,
+                    adapter_ids=adapter_ids,
                 )
                 # Scatter ONLY the new row back to its page.
                 blk = tables[rows, pos // bs]  # [B]
@@ -1139,15 +1368,13 @@ class PagedDecodeServer:
             x, (pk, pv) = lax.scan(
                 body, x, (params["stack"], pk, pv)
             )
-            logits = dec._final_logits(params, x)
+            logits = self._replicate_logits(dec._final_logits(params, x))
             return logits, pk, pv
 
         return step
 
     def _build_step_blockwise(self):
-        return jax.jit(
-            self._step_body_blockwise(), donate_argnums=(1, 2)
-        )
+        return self._jit_tick(self._step_body_blockwise(), n_rep=4)
 
     def _step_body_blockwise(self):
         """The block-native pure-XLA step: same embed/projection/FFN
@@ -1162,10 +1389,11 @@ class PagedDecodeServer:
         block 0 row 0, the module invariant."""
         dec, bs = self.dec, self.bs
         window = dec.cfg.window
+        tp = self._tp_axis()
 
         def step(params, pk, pv, tables, pos, ids, adapter_ids):
             b = ids.shape[0]
-            x = dec._embed_tokens(params, ids, pos)
+            x = dec._embed_tokens(params, ids, pos, tp)
             rows = jnp.arange(b)
             blk_w = tables[rows, pos // bs]  # [B]
             row_w = pos % bs
@@ -1185,22 +1413,20 @@ class PagedDecodeServer:
                     q, pk_l, pv_l, tables, pos, bs, nb_live, window
                 )
                 out = dec._attn_out(
-                    p, x, attn, adapter_ids=adapter_ids
+                    p, x, attn, tp, adapter_ids=adapter_ids
                 )
                 return out, (pk_l, pv_l)
 
             x, (pk, pv) = lax.scan(
                 body, x, (params["stack"], pk, pv)
             )
-            logits = dec._final_logits(params, x)
+            logits = self._replicate_logits(dec._final_logits(params, x))
             return logits, pk, pv
 
         return step
 
     def _build_step_pallas(self):
-        return jax.jit(
-            self._step_body_pallas(), donate_argnums=(1, 2)
-        )
+        return self._jit_tick(self._step_body_pallas(), n_rep=4)
 
     def _step_body_pallas(self):
         """The kernel variant of the block-native step: attention goes
@@ -1216,10 +1442,11 @@ class PagedDecodeServer:
         dec, bs = self.dec, self.bs
         window = dec.cfg.window
         interpret = _flash_decode_mode() != "tpu"
+        tp = self._tp_axis()
 
         def step(params, pk, pv, tables, pos, ids, adapter_ids):
             b = ids.shape[0]
-            x = dec._embed_tokens(params, ids, pos)
+            x = dec._embed_tokens(params, ids, pos, tp)
             rows = jnp.arange(b)
             blk_w = tables[rows, pos // bs]
             row_w = pos % bs
@@ -1244,14 +1471,14 @@ class PagedDecodeServer:
                 )  # [B, Hq, Dh]
                 attn = attn.astype(x.dtype).reshape(b_, 1, hq * dh)
                 out = dec._attn_out(
-                    p, x, attn, adapter_ids=adapter_ids
+                    p, x, attn, tp, adapter_ids=adapter_ids
                 )
                 return out, (pk_l, pv_l)
 
             x, (pk, pv) = lax.scan(
                 body, x, (params["stack"], pk, pv)
             )
-            logits = dec._final_logits(params, x)
+            logits = self._replicate_logits(dec._final_logits(params, x))
             return logits, pk, pv
 
         return step
@@ -1269,10 +1496,8 @@ class PagedDecodeServer:
 
             self._mt = cached_step(
                 self.dec,
-                ("paged_mt", self.bs, self.attention),
-                lambda: jax.jit(
-                    self._mt_body(), donate_argnums=(1, 2)
-                ),
+                ("paged_mt", self.bs, self.attention, self._mesh_key),
+                lambda: self._jit_tick(self._mt_body(), n_rep=6),
             )
         return self._mt
 
@@ -1306,6 +1531,7 @@ class PagedDecodeServer:
         dec, bs = self.dec, self.bs
         attention = self.attention
         window = dec.cfg.window
+        tp = self._tp_axis()
         if attention == "pallas":
             from defer_tpu.models.gpt import _flash_decode_mode
             from defer_tpu.ops.pallas_attention import (
@@ -1335,7 +1561,7 @@ class PagedDecodeServer:
             )
             dest = jnp.where(keep, blk, 0)
             rowi = pvec % bs
-            x = dec._embed_tokens(params, ids, pos)
+            x = dec._embed_tokens(params, ids, pos, tp)
 
             if attention == "gathered":
 
@@ -1352,7 +1578,8 @@ class PagedDecodeServer:
                         b_, hkv, mb_ * bs, dh
                     )
                     out, kc, vc = dec._block(
-                        p, x, kc, vc, pos, adapter_ids=adapter_ids
+                        p, x, kc, vc, pos, tp_axis=tp,
+                        adapter_ids=adapter_ids,
                     )
                     # Multi-row scatter-back: T fresh rows per slot.
                     new_k = kc[rows[:, None], :, pvec, :]
@@ -1384,7 +1611,7 @@ class PagedDecodeServer:
                         window,
                     )
                     out = dec._attn_out(
-                        p, x, attn, adapter_ids=adapter_ids
+                        p, x, attn, tp, adapter_ids=adapter_ids
                     )
                     return out, (pk_l, pv_l)
 
@@ -1418,14 +1645,14 @@ class PagedDecodeServer:
                         .astype(x.dtype)
                     )
                     out = dec._attn_out(
-                        p, x, attn, adapter_ids=adapter_ids
+                        p, x, attn, tp, adapter_ids=adapter_ids
                     )
                     return out, (pk_l, pv_l)
 
             x, (pk, pv) = lax.scan(
                 body, x, (params["stack"], pk, pv)
             )
-            logits = dec._final_logits(params, x)
+            logits = self._replicate_logits(dec._final_logits(params, x))
             return logits, pk, pv
 
         return step
@@ -1499,13 +1726,52 @@ class PagedDecodeServer:
                 )
                 return pk, pv, feed, alive, keys, n, toks.T
 
-            return jax.jit(window, donate_argnums=(1, 2))
+            if self.mesh is None:
+                return jax.jit(window, donate_argnums=(1, 2))
+            # Sharded window: the whole K-sub-step scan runs inside
+            # ONE shard_map — per sub-step the raw body all_gathers
+            # its vocab slices, so sampling sees replicated post-psum
+            # logits and every shard advances the identical feed/keys
+            # state (sampler inputs are replicated operands).
+            from jax.sharding import PartitionSpec as PSpec
+
+            from defer_tpu.utils.compat import shard_map
+
+            pool, r = self._pool_spec, PSpec()
+            sm = shard_map(
+                window,
+                self.mesh,
+                in_specs=(self._sdec._specs(), pool, pool)
+                + (r,) * 11,
+                out_specs=(pool, pool, r, r, r, r, r),
+                check_rep=False,
+            )
+            return jax.jit(sm, donate_argnums=(1, 2))
 
         return cached_step(
             self.dec,
-            ("paged_window", self.bs, self.attention, K, mode, eos),
+            ("paged_window", self.bs, self.attention, K, mode, eos,
+             self._mesh_key),
             build,
         )
+
+    def _pool_constraint(self, *arrays):
+        """Pin pool-layout (or flat-lane) outputs of the plain-jit
+        data-movement programs (insert / gather / import) to the
+        KV-head sharding when serving on a mesh: the programs stay
+        ordinary GSPMD jits — XLA partitions the scatters — but the
+        constraint stops the partitioner from ever materializing a
+        gathered pool. No-op on mesh=None. All these layouts carry
+        their head axis at index 2, so one spec serves them all."""
+        if self.mesh is None:
+            return arrays if len(arrays) > 1 else arrays[0]
+        from jax.sharding import NamedSharding
+
+        sh = NamedSharding(self.mesh, self._pool_spec)
+        out = tuple(
+            lax.with_sharding_constraint(a, sh) for a in arrays
+        )
+        return out if len(out) > 1 else out[0]
 
     def _build_insert(self, skip: int = 0):
         bs = self.bs
@@ -1546,7 +1812,7 @@ class PagedDecodeServer:
             dest = table_row[skip:]
             pk = pk.at[:, dest].set(k_blocks[:, skip:])
             pv = pv.at[:, dest].set(v_blocks[:, skip:])
-            return pk, pv
+            return self._pool_constraint(pk, pv)
 
         return jax.jit(insert, donate_argnums=(0, 1))
 
@@ -1587,7 +1853,7 @@ class PagedDecodeServer:
             dest = jnp.where(jnp.arange(mb) >= skip, table_row, 0)
             pk = pk.at[:, dest].set(k_blocks)
             pv = pv.at[:, dest].set(v_blocks)
-            return pk, pv
+            return self._pool_constraint(pk, pv)
 
         return jax.jit(insert, donate_argnums=(0, 1))
 
@@ -1607,7 +1873,7 @@ class PagedDecodeServer:
             vc = vc.transpose(0, 2, 1, 3, 4).reshape(
                 L, 1, hkv, mb * bs, dh
             )
-            return kc, vc
+            return self._pool_constraint(kc, vc)
 
         return jax.jit(gather)
 
@@ -1662,6 +1928,7 @@ class PagedDecodeServer:
                 adapter,
             )
             self._account_kv_rows_prefill(pos0, pad_t)
+            self._account_psums(1)
             logits_row = logits[:, real - 1, :]
             start += real
         return logits_row
@@ -1684,9 +1951,7 @@ class PagedDecodeServer:
             hi = (pos0 + t - 1) // bs
             lo = max(pos0 - win + 1, 0) // bs if win is not None else 0
             rows_read = (hi - lo + 1) * bs
-        self.obs.kv_rows_read.inc(rows_read)
-        self.obs.kv_rows_gathered.inc(baseline)
-        self.obs.kv_rows_last.set(rows_read)
+        self._account_kv_rows(rows_read, baseline)
 
     def _admit_radix(
         self, i, rid, prompt, steps, adapter_id, samp, stop_seqs
@@ -1759,16 +2024,17 @@ class PagedDecodeServer:
                     "pos": jnp.asarray(suffix_pos, jnp.int32),
                 }
             else:
-                small = self.dec.init_cache(1)
+                small = self._flat_dec().init_cache(1)
             pad = 1 << (ts - 1).bit_length()
             pad = min(pad, self.dec.cfg.max_len - suffix_pos)
             padded = jnp.concatenate(
                 [suffix, jnp.zeros((1, pad - ts), prompt.dtype)],
                 axis=1,
             )
-            logits, small = self.dec.make_step()(
+            logits, small = self._flat_dec().make_step()(
                 self.params, small, padded
             )
+            self._account_psums(1)
             # Dynamic-skip insert: hit blocks are never rewritten
             # (their recomputed rows are equivalent but not guaranteed
             # bit-identical, and they belong to every other holder of
@@ -1868,7 +2134,10 @@ class PagedDecodeServer:
         lane = blocks.transpose(0, 2, 1, 3, 4).reshape(
             L, hkv, n_pad * bs, dh
         )
-        return jnp.asarray(lane[:, None])
+        # Under a mesh this is the disagg TP-ingest scatter: the wire
+        # blob carries all kv heads, and the head-sharded device_put
+        # slices each shard's heads out at ingest (wire unchanged).
+        return self._shard_ingest(lane[:, None])
 
     def _admit_prefilled(self, i: int, rid: int, entry: dict) -> bool:
         """Seat a request whose KV arrived from a prefill worker:
@@ -2069,16 +2338,17 @@ class PagedDecodeServer:
                 # exists to avoid); the returned cache is a fresh
                 # tree.
                 if self._prefix_cache is None:
-                    small = self.dec.init_cache(1)
+                    small = self._flat_dec().init_cache(1)
                 else:
                     small = dict(self._prefix_cache)
                 if self.multi_lora:
                     small["adapter"] = jnp.full(
                         (1,), adapter_id, jnp.int32
                     )
-                logits, small = self.dec.make_step(donate=False)(
+                logits, small = self._flat_dec().make_step(donate=False)(
                     self.params, small, padded
                 )
+                self._account_psums(1)
                 self.pool_k, self.pool_v = self._insert(
                     self.pool_k,
                     self.pool_v,
@@ -2169,6 +2439,7 @@ class PagedDecodeServer:
         self._last_tick_t = now
         self.obs.ticks.inc()
         self.obs.host_dispatches.inc()
+        self._account_psums(1)
         self.obs.tokens_per_dispatch.set(float(n_live))
         self.window_tokens += n_live
         # K/V rows the attention path read this tick vs the gathered
@@ -2191,9 +2462,7 @@ class PagedDecodeServer:
                 else 0
             )
             rows_read = int(np.sum(posm // self.bs - lo + 1)) * self.bs
-        self.obs.kv_rows_read.inc(rows_read)
-        self.obs.kv_rows_gathered.inc(baseline)
-        self.obs.kv_rows_last.set(rows_read)
+        self._account_kv_rows(rows_read, baseline)
         if any(s is not None and s["sampling"] for s in self.slots):
             nxt = self._sampler.draw(logits[:, -1, :])
         else:
@@ -2319,6 +2588,9 @@ class PagedDecodeServer:
         self._last_tick_t = now
         self.obs.ticks.inc()
         self.obs.host_dispatches.inc(2)
+        # Only the verify forward runs sharded; the draft's flat lanes
+        # are replicated host-side state, no collectives.
+        self._account_psums(1)
         # Pool rows the verify forward read (same units/contract as
         # the K=1 tick; the draft reads its own flat lanes, not the
         # pool). The deepest query row of slot i attends at pos + k.
@@ -2340,9 +2612,7 @@ class PagedDecodeServer:
                 else np.zeros_like(posm)
             )
             rows_read = int(np.sum(hi - lo + 1)) * self.bs
-        self.obs.kv_rows_read.inc(rows_read)
-        self.obs.kv_rows_gathered.inc(baseline)
-        self.obs.kv_rows_last.set(rows_read)
+        self._account_kv_rows(rows_read, baseline)
         # analysis: ignore[host-sync-in-hot-loop] the ONE batched
         # accept-test transfer per speculative ROUND — up to k+1
         # tokens per slot amortize it, the sync the round is designed
@@ -2502,6 +2772,9 @@ class PagedDecodeServer:
         self._last_tick_t = now
         self.obs.ticks.inc()
         self.obs.host_dispatches.inc()
+        # The fused window scans K sub-steps inside ONE sharded
+        # program: K forwards' worth of collectives per dispatch.
+        self._account_psums(K)
         need_toks = self.on_token is not None or any(
             s is not None and s["stop"] is not None
             for s in self.slots
@@ -2562,9 +2835,7 @@ class PagedDecodeServer:
                         + 1
                         for p in pe
                     )
-        self.obs.kv_rows_read.inc(rows_read)
-        self.obs.kv_rows_gathered.inc(baseline)
-        self.obs.kv_rows_last.set(rows_read)
+        self._account_kv_rows(rows_read, baseline)
 
     def _drain_window(
         self, toks, toks_host, emitted, alive_host, budget
@@ -2690,6 +2961,8 @@ def serve_paged(
     spec_params: dict | None = None,
     spec_k: int = 0,
     prefill_chunk: int | None = None,
+    mesh: Any = None,
+    model_axis: str = "model",
 ) -> tuple[list[jax.Array], dict]:
     """One-shot paged serving; returns (outputs in submission order,
     stats incl. peak pool usage). `adapter_ids` optionally assigns a
@@ -2710,7 +2983,13 @@ def serve_paged(
     outputs stay token-identical to `spec_k=0`; stats then also carry
     `spec_rounds` / `spec_proposed` / `spec_accepted` /
     `spec_acceptance`. `prefill_chunk=C` switches admission to the
-    pool-native chunked prefill path."""
+    pool-native chunked prefill path.
+
+    `mesh=` / `model_axis=` run the server tensor-parallel: weights
+    and the KV block pool shard over the named mesh axis and every
+    tick body runs under shard_map (PagedDecodeServer docstring has
+    the layout). Greedy output is token-identical to `mesh=None`;
+    stats then also carry `mesh_shape` and `tp_psums`."""
     srv = PagedDecodeServer(
         dec,
         params,
@@ -2726,6 +3005,8 @@ def serve_paged(
         spec_params=spec_params,
         spec_k=spec_k,
         prefill_chunk=prefill_chunk,
+        mesh=mesh,
+        model_axis=model_axis,
     )
     aids = adapter_ids or [0] * len(requests)
     if len(aids) != len(requests):
@@ -2772,5 +3053,7 @@ def serve_paged(
             else 0.0
         ),
         prefill_chunk=srv.prefill_chunk,
+        mesh_shape=srv.mesh_label,
+        tp_psums=srv.tp_psums,
     )
     return [done[r] for r in rids], stats

@@ -26,7 +26,11 @@ bench.py runs it as the "decode_window" extras section. And
 speculative-decoding sweep (spec_k in {0,2,4}, self-draft so
 acceptance is 1.0) pricing tokens/sec, acceptance and
 dispatches-per-token per k; bench.py runs it as the "speculative"
-extras section.
+extras section. And `run_tp_sweep(devices) -> dict` (`--tp-sweep`) —
+the tensor-parallel serving sweep (model_axis in {1,2,4,8} on a
+{"model": m} mesh, runtime/paged.py `mesh=`) pricing tokens/sec,
+tokens-per-dispatch and per-shard KV rows read per axis size;
+bench.py runs it as the "tp_serving" extras section.
 
 "pallas" is excluded by default off-TPU: the interpret-mode kernel is
 functionally identical but interpreter-slow, which would price the
@@ -401,6 +405,132 @@ def run_spec_sweep(
     return out
 
 
+def run_tp_sweep(
+    devices=None,
+    *,
+    axes: tuple = (1, 2, 4, 8),
+    num_layers: int = 4,
+    dim: int = 256,
+    num_heads: int = 8,
+    num_kv_heads: int = 8,
+    vocab_size: int = 2048,
+    max_len: int = 512,
+    num_blocks: int = 49,
+    block_size: int = 16,
+    max_batch: int = 4,
+    num_requests: int = 8,
+) -> dict:
+    """Tensor-parallel serving sweep: the same fixed request mix served
+    on a {"model": m} mesh for each axis size m that fits the visible
+    devices (CPU runs force 8 host devices via XLA_FLAGS, the test
+    rig's idiom). Returns {config, device_kind, axes: {m:
+    {tokens_per_sec, host_dispatches, dispatches_per_token,
+    tokens_per_dispatch, kv_rows_read_per_shard, kv_rows_scaling,
+    tp_psums, mesh_shape}}}.
+
+    The points being measured: host dispatches per token must NOT move
+    with m (one dispatch drives all shards — the contract the
+    counter-pinned test enforces), per-shard KV rows read must fall as
+    1/m (each shard owns kv_heads/m heads of every block), and
+    tokens/sec prices what the psum/all-gather chatter costs on this
+    interconnect. `num_kv_heads` defaults to 8 so every swept axis
+    divides it."""
+    import jax
+    import jax.numpy as jnp
+
+    from defer_tpu import obs
+    from defer_tpu.models.gpt import GptDecoder
+    from defer_tpu.models.llama import llama_config
+    from defer_tpu.parallel.mesh import describe_topology, make_mesh
+    from defer_tpu.runtime.paged import serve_paged
+
+    devs = list(devices) if devices else jax.devices()
+    cfg = llama_config(
+        num_layers=num_layers,
+        dim=dim,
+        num_heads=num_heads,
+        num_kv_heads=num_kv_heads,
+        ffn_dim=dim * 2,
+        vocab_size=vocab_size,
+        max_len=max_len,
+    )
+    dec = GptDecoder(cfg, compute_dtype=jnp.bfloat16)
+    params = dec.cast_params(dec.init(jax.random.key(0)))
+    reqs = []
+    for i in range(num_requests):
+        t0 = 16 + (i * 23) % 112
+        steps = 16 + (i * 11) % 48
+        prompt = jax.random.randint(
+            jax.random.fold_in(jax.random.key(1), i),
+            (1, t0),
+            0,
+            cfg.vocab_size,
+        )
+        reqs.append((prompt, steps))
+    total_tokens = sum(s for _, s in reqs)
+    topo = describe_topology()
+    out: dict = {
+        "config": {
+            "num_layers": num_layers,
+            "dim": dim,
+            "heads": f"{num_heads}/{num_kv_heads}kv",
+            "max_len": max_len,
+            "num_blocks": num_blocks,
+            "block_size": block_size,
+            "max_batch": max_batch,
+            "requests": num_requests,
+            "total_tokens": total_tokens,
+        },
+        "device_kind": topo["device_kind"],
+        "num_devices": len(devs),
+        "skipped_axes": [m for m in axes if m > len(devs)],
+        "axes": {},
+    }
+    base_rows = None
+    for m in axes:
+        if m > len(devs):
+            continue
+        mesh = make_mesh({"model": m}, devs[:m])
+        mesh_shape = f"model={m}"
+        lab = f'mesh="{mesh_shape}",server="paged"'
+
+        def run():
+            t0 = time.perf_counter()
+            with obs.counter_deltas() as d:
+                outs, stats = serve_paged(
+                    dec,
+                    params,
+                    reqs,
+                    num_blocks=num_blocks,
+                    block_size=block_size,
+                    max_batch=max_batch,
+                    mesh=mesh,
+                )
+                jax.block_until_ready(outs[-1])
+            return time.perf_counter() - t0, d, stats
+
+        run()  # compile pass
+        dt, deltas, stats = run()
+        rows = deltas.get(f"defer_kv_rows_read_total{{{lab}}}", 0)
+        if base_rows is None:
+            base_rows = rows
+        out["axes"][m] = {
+            "tokens_per_sec": round(total_tokens / dt, 1),
+            "host_dispatches": stats["host_dispatches"],
+            "dispatches_per_token": round(
+                stats["host_dispatches"] / total_tokens, 4
+            ),
+            "tokens_per_dispatch": round(
+                stats["tokens_per_dispatch"], 2
+            ),
+            "kv_rows_read_per_shard": rows,
+            "kv_rows_scaling": round(rows / max(1, base_rows), 4),
+            "tp_psums": stats["tp_psums"],
+            "mesh_shape": mesh_shape,
+        }
+    return out
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(
         description="paged-decode attention microbench (one JSON line)"
@@ -444,6 +574,18 @@ def main() -> None:
         help="comma-separated spec_k values for --spec-sweep "
         "(0 = non-speculative baseline)",
     )
+    ap.add_argument(
+        "--tp-sweep",
+        action="store_true",
+        help="run the tensor-parallel serving sweep (model_axis = "
+        "--tp-axes, axes that exceed the visible devices are skipped "
+        "and reported) instead of the attention microbench",
+    )
+    ap.add_argument(
+        "--tp-axes",
+        default="1,2,4,8",
+        help="comma-separated model-axis sizes for --tp-sweep",
+    )
     args = ap.parse_args()
     shared = dict(
         num_layers=args.layers,
@@ -457,7 +599,30 @@ def main() -> None:
         max_batch=args.batch,
         num_requests=args.requests,
     )
-    if args.spec_sweep:
+    if args.tp_sweep:
+        # Same default-dropping as --spec-sweep: run_tp_sweep's own
+        # model defaults (kv_heads=8 so every axis divides) win unless
+        # a flag was explicitly overridden.
+        arg_of = {
+            "num_layers": "layers",
+            "dim": "dim",
+            "num_heads": "heads",
+            "num_kv_heads": "kv_heads",
+            "vocab_size": "vocab",
+            "max_len": "max_len",
+            "num_blocks": "blocks",
+            "block_size": "block_size",
+            "max_batch": "batch",
+            "num_requests": "requests",
+        }
+        shared = {
+            k: v
+            for k, v in shared.items()
+            if v != ap.get_default(arg_of[k])
+        }
+        axes = tuple(int(m) for m in args.tp_axes.split(",") if m)
+        rec = run_tp_sweep(axes=axes, **shared)
+    elif args.spec_sweep:
         # Let run_spec_sweep's own (smaller) model defaults win unless
         # the user explicitly overrode a flag: entries still at the
         # parser default are dropped.

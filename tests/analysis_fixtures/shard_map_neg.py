@@ -1,0 +1,38 @@
+"""NEGATIVE: the sharded tick shape the paged server actually ships —
+the shard_map-wrapped body is pure traced jax (psum-reduced, logits
+all-gathered in-body), every host transfer stays OUTSIDE at the tick
+level behind the sanctioned batched-drain ignore. The wrapper edge
+makes `body` hot; nothing inside it syncs."""
+
+import numpy as np
+from jax import lax
+
+from defer_tpu.utils.compat import shard_map
+
+
+class Server:
+    def _tick(self):
+        step = self._build_step()
+        logits, self.pool = step(self.params, self.pool, self.feed)
+        # analysis: ignore[host-sync-in-hot-loop] one batched transfer
+        # per tick by design — the drain the loop is built around
+        toks = np.asarray(logits.argmax(-1))
+        self._emit(toks)
+
+    def _build_step(self):
+        def body(params, pool, feed):
+            x = self._embed(params, feed)
+            attn = self._attend(params, pool, x)
+            out = lax.psum(attn @ params["wo"], "model")
+            return lax.all_gather(out, "model", axis=-1, tiled=True), pool
+
+        return shard_map(
+            body, self.mesh,
+            in_specs=(None, None, None), out_specs=(None, None),
+        )
+
+    def _attend(self, params, pool, x):
+        return x @ pool  # local KV shard only; pure device math
+
+    def _emit(self, toks):
+        self.out.extend(toks.tolist())

@@ -1,0 +1,29 @@
+"""POSITIVE: host syncs inside a shard_map-wrapped tick body. The
+body is a nested def passed to `shard_map` BY NAME from a builder the
+`_tick` root reaches — the wrapper edge must carry hotness through,
+so both the sync inside the sharded body and the one in a helper it
+calls must flag."""
+
+import numpy as np
+from defer_tpu.utils.compat import shard_map
+
+
+class Server:
+    def _tick(self):
+        step = self._build_step()
+        logits, self.pool = step(self.params, self.pool, self.feed)
+
+    def _build_step(self):
+        def body(params, pool, feed):
+            x = self._embed(params, feed)
+            depth = feed.item()  # per-tick sync INSIDE the sharded body
+            return self._attend(params, pool, x, depth), pool
+
+        return shard_map(
+            body, self.mesh,
+            in_specs=(None, None, None), out_specs=(None, None),
+        )
+
+    def _attend(self, params, pool, x, depth):
+        rows = np.asarray(pool[:depth])  # reachable through the body
+        return x @ rows
