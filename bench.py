@@ -609,6 +609,30 @@ def bench_tp_serving(devices) -> dict:
     return rec
 
 
+def bench_kv_quant(devices) -> dict:
+    """KV quantization + spill tier (scripts/bench_paged.py): the same
+    over-subscribed Zipf prefix mix served with a fp pool vs an
+    int8+scales pool, spill tier on — pricing tokens/sec,
+    resident-requests-per-pool-MiB (the capacity headline: int8 holds
+    the same blocks in itemsize-fold fewer bytes) and the spill
+    revival rate, with prefill tokens vs a no-spill baseline showing
+    the rows revivals saved."""
+    import importlib.util
+    import os
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "scripts",
+        "bench_paged.py",
+    )
+    spec = importlib.util.spec_from_file_location("bench_paged", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rec = mod.run_kv_quant_sweep(devices)
+    log(f"kv quant sweep: {rec}")
+    return rec
+
+
 def bench_disagg(devices) -> dict:
     """Disaggregated serving (scripts/bench_disagg.py): the same
     request mix through monolithic serve_paged and split serve_disagg
@@ -1036,6 +1060,7 @@ def run_bench() -> dict:
             ("decode_window", bench_decode_window),
             ("speculative", bench_speculative),
             ("tp_serving", bench_tp_serving),
+            ("kv_quant", bench_kv_quant),
             ("disagg", bench_disagg),
             ("fleet", bench_fleet),
             ("bert_base", bench_bert),

@@ -128,6 +128,7 @@ def serve_disagg(
     eos_id: int | None = None,
     prefix_cache: bool = False,
     attention: str = "gathered",
+    kv_dtype: str = "fp",
     decode_window: int = 1,
     sampling: list | None = None,
     stop: list | None = None,
@@ -149,7 +150,13 @@ def serve_disagg(
     would fork the first token). `server=` reuses an existing
     PagedDecodeServer so ingested prefix blocks survive into later
     local serving (cross-host prefix warm-up). `worker_retries` bounds
-    mid-stream worker replacements before giving up."""
+    mid-stream worker replacements before giving up.
+
+    `kv_dtype="int8"` stores the decode pool quantized: `deliver_kv`'s
+    jitted scatter requantizes the decoded wire blocks on landing, so
+    a Q8 transfer (`quantize="int8"`) feeding an int8 pool never holds
+    a widened copy beyond the ingest staging buffer — the wire format
+    itself is unchanged."""
     srv = server
     if srv is None:
         srv = PagedDecodeServer(
@@ -161,6 +168,7 @@ def serve_disagg(
             eos_id=eos_id,
             prefix_cache=prefix_cache,
             attention=attention,
+            kv_dtype=kv_dtype,
             decode_window=decode_window,
         )
     samps = sampling or [None] * len(requests)
@@ -277,7 +285,7 @@ def serve_disagg(
         ticks=srv.ticks,
         attention=srv.attention,
         peak_blocks=srv.blocks_peak,
-        pool_blocks=int(srv.pool_k.shape[1]) - 1,
+        pool_blocks=srv.num_blocks - 1,
         block_size=srv.bs,
         decode_window=srv.decode_window,
         host_dispatches=srv.dispatches,
@@ -288,6 +296,8 @@ def serve_disagg(
             srv.radix.cached_blocks if srv.radix is not None else 0
         ),
         prefill_tokens_saved=srv.prefill_tokens_saved,
+        kv_dtype=srv.kv_dtype,
+        pool_bytes=srv.pool_bytes,
         disagg=True,
         quantize=quantize,
         kv_bytes_recv=recv.rx_frame_bytes,

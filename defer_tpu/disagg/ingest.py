@@ -15,6 +15,15 @@ MUTATION DOMAIN, not by convenience:
     therefore stays on the serving thread, the same single-writer
     discipline the server's own admission path relies on.
 
+The same split carries the quantized pool (runtime/paged.py
+`kv_dtype="int8"`) for free: payloads stay in the wire's compute
+dtype all the way to `deliver_kv`, and the requantize happens inside
+`_admit`'s jitted scatter on the serving thread — the drain thread
+never needs to know the pool dtype. The host-RAM spill tier
+(`runtime/paged.py::HostKVSpill`) runs this exact mutation-domain
+split in the other direction: its drain thread does device->host
+copies only, while pool revival stays on the serving thread.
+
 Failure protocol (the retry seam `disagg/api.py` drives): a transport
 death flips `failed` and parks the drain thread; the orchestrator
 drops the dead peer (`receiver.next_peer()`), respawns a worker,

@@ -79,6 +79,8 @@ class FleetFrontend:
         eos_id: int | None = None,
         prefix_cache: bool = False,
         attention: str = "gathered",
+        kv_dtype: str = "fp",
+        spill_bytes: int = 0,
         decode_window: int = 1,
         policy: str = "prefix",
         slo_s: float | None = None,
@@ -150,6 +152,8 @@ class FleetFrontend:
                 eos_id=eos_id,
                 prefix_cache=prefix_cache,
                 attention=attention,
+                kv_dtype=kv_dtype,
+                spill_bytes=spill_bytes,
                 decode_window=decode_window,
                 **_placement(i),
             )
@@ -318,7 +322,7 @@ class FleetFrontend:
                     ticks=srv.ticks,
                     attention=srv.attention,
                     peak_blocks=srv.blocks_peak,
-                    pool_blocks=int(srv.pool_k.shape[1]) - 1,
+                    pool_blocks=srv.num_blocks - 1,
                     block_size=srv.bs,
                     decode_window=srv.decode_window,
                     host_dispatches=srv.dispatches,
@@ -329,6 +333,14 @@ class FleetFrontend:
                     ),
                     prefill_tokens_saved=srv.prefill_tokens_saved,
                     mesh_shape=srv.mesh_label,
+                    kv_dtype=srv.kv_dtype,
+                    pool_bytes=srv.pool_bytes,
+                    spilled_blocks=(
+                        srv._spill.stored_blocks
+                        if srv._spill is not None
+                        else 0
+                    ),
+                    spill_hits=srv.spill_hits_n,
                     dead=str(r.dead) if r.dead is not None else None,
                 )
             )
@@ -355,6 +367,8 @@ def serve_fleet(
     eos_id: int | None = None,
     prefix_cache: bool = False,
     attention: str = "gathered",
+    kv_dtype: str = "fp",
+    spill_bytes: int = 0,
     decode_window: int = 1,
     sampling: list | None = None,
     stop: list | None = None,
@@ -379,7 +393,13 @@ def serve_fleet(
     Placement: replicas partition `jax.devices()` (or `devices=`)
     disjointly, one device each by default; `model_axis_size=m` gives
     each replica its own m-device "model" mesh and serves it
-    tensor-parallel (FleetFrontend docstring has the contract)."""
+    tensor-parallel (FleetFrontend docstring has the contract).
+
+    `kv_dtype`/`spill_bytes` apply to every replica's pool
+    (PagedDecodeServer docstring). Prefix-block migration between
+    replicas is dtype-transparent: export dequantizes to the wire's
+    compute dtype and the importing replica's pool requantizes on
+    landing, so mixed-pool fleets still migrate."""
     fe = FleetFrontend(
         dec,
         params,
@@ -390,6 +410,8 @@ def serve_fleet(
         eos_id=eos_id,
         prefix_cache=prefix_cache,
         attention=attention,
+        kv_dtype=kv_dtype,
+        spill_bytes=spill_bytes,
         decode_window=decode_window,
         policy=policy,
         slo_s=slo_s,

@@ -102,6 +102,10 @@ class ThreadReplica:
         self._stop.set()
         if self._thread.is_alive():
             self._thread.join(timeout=timeout)
+        if self.srv._spill is not None:
+            # Settle the host spill tier's drain thread so its gauges
+            # (and any caller reading stored_bytes) see a final value.
+            self.srv._spill.close()
 
     def inject_failure(self, exc: BaseException) -> None:
         self._fail = exc

@@ -654,7 +654,14 @@ class GptDecoder:
         `pos` is the cache write head: a scalar (all batch elements at
         the same depth — generate/prefill), or a (B,) vector when
         every slot sits at its own depth (continuous batching,
-        runtime/decode_server.py); the branch is trace-time static."""
+        runtime/decode_server.py); the branch is trace-time static.
+
+        Dtype contract for callers that own their cache storage: the
+        caches arrive here ALREADY in the block's compute dtype. The
+        paged server's int8 pool (runtime/paged.py kv_dtype="int8")
+        dequantizes at its gather and requantizes the returned new
+        rows at its scatter, so this read path — and the new_k/new_v
+        it hands back — is storage-dtype-agnostic by construction."""
         cfg = self.cfg
         dt = x.dtype
         dh = cfg.dim // cfg.num_heads

@@ -30,6 +30,52 @@ import jax.numpy as jnp
 DEFAULT_KEYS = ("wq", "wk", "wv", "wo", "w1", "w2", "w3")
 
 
+def quantize_symmetric(x, axis=None, *, keepdims: bool = False, xp=jnp):
+    """THE symmetric-int8 convention, shared by weight leaves, the
+    codec's SCHEME_Q8 wire frames and the paged KV pool: q =
+    clip(round(x / s), -127, 127) with s = max|x| / 127 reduced over
+    `axis` (None = per-tensor). Degenerate scales — all-zero input, or
+    an amax so small that amax/127 underflows (or is flushed) to 0 —
+    clamp to 1.0, so the tensor quantizes to zeros instead of clipped
+    +/-127 garbage. Non-finite inputs are the caller's contract: the
+    codec raises before calling; jitted pool writes never see them.
+
+    `xp` selects the array namespace (jnp for device code, np for the
+    host-side codec, which quantizes in fp64). Returns (q, s); with
+    keepdims=False the scale drops the reduced axes."""
+    xf = xp.asarray(x)
+    if not xp.issubdtype(xf.dtype, xp.floating):
+        xf = xf.astype(xp.float32)
+    if xf.size:
+        amax = xp.max(xp.abs(xf), axis=axis, keepdims=True)
+    else:  # empty tensors (codec edge case): np.max would raise
+        red = (
+            tuple(range(xf.ndim))
+            if axis is None
+            else ((axis,) if isinstance(axis, int) else tuple(axis))
+        )
+        red = {a % xf.ndim for a in red}
+        shape = tuple(
+            1 if i in red else d for i, d in enumerate(xf.shape)
+        )
+        amax = xp.zeros(shape, xf.dtype)
+    s = amax / 127.0
+    s = xp.where(s > 0.0, s, xp.ones_like(s))
+    q = xp.clip(xp.round(xf / s), -127, 127).astype(xp.int8)
+    if not keepdims:
+        s = xp.squeeze(s, axis=axis)
+    return q, s
+
+
+def dequantize_symmetric(q, s, dtype: Any = jnp.float32, *, xp=jnp):
+    """Inverse of quantize_symmetric: widen q and fold the scale back
+    in. `s` must be broadcastable to `q` (keepdims scales are; reduced
+    ones need the caller to re-expand). The multiply happens in
+    `dtype`, so the codec's fp64 round-trip and a bf16 pool read both
+    route through the same two lines."""
+    return xp.asarray(q).astype(dtype) * xp.asarray(s).astype(dtype)
+
+
 def quantize_leaf(w: jax.Array) -> dict[str, jax.Array]:
     """Symmetric per-output-channel int8: q = round(w / s) with
     s = max|w| / 127 over the contraction axes. The scale keeps
@@ -42,9 +88,7 @@ def quantize_leaf(w: jax.Array) -> dict[str, jax.Array]:
         if wf.ndim >= 3
         else tuple(range(wf.ndim - 1))
     )
-    s = jnp.max(jnp.abs(wf), axis=red, keepdims=True) / 127.0
-    s = jnp.maximum(s, 1e-12)
-    q = jnp.clip(jnp.round(wf / s), -127, 127).astype(jnp.int8)
+    q, s = quantize_symmetric(wf, axis=red, keepdims=True)
     return {"q": q, "s": s.astype(jnp.float32)}
 
 
@@ -53,7 +97,7 @@ def dequantize_leaf(leaf: Any, dtype: Any) -> jax.Array:
     (cast), so call sites handle mixed quantized/plain trees with one
     helper. Inside jit the convert+scale fuses into the consumer."""
     if isinstance(leaf, dict) and "q" in leaf:
-        return leaf["q"].astype(dtype) * leaf["s"].astype(dtype)
+        return dequantize_symmetric(leaf["q"], leaf["s"], dtype)
     return leaf.astype(dtype)
 
 

@@ -112,6 +112,34 @@ class ServingMetrics:
             "defer_prefix_cache_revivals_total",
             "Parked cache blocks revived by a new sharer", labels,
         )
+        # KV-pool storage + host-RAM spill tier (runtime/paged.py
+        # kv_dtype= / spill_bytes=). kv_pool_bytes is the pool's
+        # RESIDENCY footprint — int8 pools read ~0.5x an fp pool plus
+        # scale overhead — while the row counters above stay dtype-
+        # agnostic (a row is a token position whatever its byte
+        # width). spill_bytes is a gauge: the store's current
+        # occupancy, trimmed oldest-first against its cap.
+        self.kv_pool_bytes = reg.gauge(
+            "defer_kv_pool_bytes",
+            "Total bytes of the paged KV pool as allocated (K + V "
+            "payloads plus int8 block scales when kv_dtype='int8')",
+            labels,
+        )
+        self.prefix_spilled = reg.counter(
+            "defer_prefix_spilled_total",
+            "Evicted prefix blocks drained into the host-RAM spill "
+            "store", labels,
+        )
+        self.prefix_spill_hits = reg.counter(
+            "defer_prefix_spill_hits_total",
+            "Radix walk misses served from the spill store (block "
+            "revived into the pool instead of re-prefilled)", labels,
+        )
+        self.spill_bytes = reg.gauge(
+            "defer_prefix_spill_bytes",
+            "Current bytes resident in the host-RAM spill store",
+            labels,
+        )
         # Block-native attention accounting (runtime/paged.py): rows
         # the tick's attention path actually read vs what the gathered
         # full-pool-view path reads regardless of depth. One unit =

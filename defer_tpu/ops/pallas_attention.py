@@ -353,15 +353,12 @@ def _paged_decode_kernel(
     q_ref,
     k_ref,
     v_ref,
-    o_ref,
-    m_scr,
-    l_scr,
-    acc_scr,
-    *,
+    *rest,
     sm_scale: float,
     block_size: int,
     window: int | None,
     num_tb: int,
+    quantized: bool,
 ):
     """One (batch, kv-head, table-column) cell of paged flash-decode:
     like `_decode_kernel`, but the K/V tile staged for column `tb` is
@@ -373,7 +370,18 @@ def _paged_decode_kernel(
     LIVE blocks are ever fetched — the bandwidth contract the paged
     pool exists for. Unallocated table entries point at trash block 0
     (runtime/paged.py invariant); the clamp keeps them un-fetched and
-    the position mask keeps block-`hi` rows past `pos` unattended."""
+    the position mask keeps block-`hi` rows past `pos` unattended.
+
+    With `quantized`, k_ref/v_ref are int8 pool tiles and two extra
+    (1, 1) scale refs follow (per-(block, head) symmetric scales,
+    staged through the SAME table indirection): the fold widens
+    int8 -> f32 and multiplies the scale in VMEM, so HBM sees one
+    byte per element — bandwidth, not just residency, halves."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        ks_ref = vs_ref = None
+        o_ref, m_scr, l_scr, acc_scr = rest
     tb = pl.program_id(2)
     p_b = pos_ref[pl.program_id(0)]
     lo, hi = _decode_lo_hi(p_b, block_size, window)
@@ -390,6 +398,9 @@ def _paged_decode_kernel(
         g = q.shape[0]
         k = k_ref[0, 0].astype(jnp.float32)  # (block_size, d)
         v = v_ref[0, 0].astype(jnp.float32)
+        if quantized:
+            k = k * ks_ref[0, 0]
+            v = v * vs_ref[0, 0]
         s = lax.dot_general(
             q,
             k,
@@ -432,6 +443,8 @@ def paged_flash_decode(
     *,
     window: int | None = None,
     interpret: bool = False,
+    scale_k: jax.Array | None = None,
+    scale_v: jax.Array | None = None,
 ) -> jax.Array:
     """Paged flash-decode: one query token per slot attending its
     BLOCK TABLE directly — no contiguous [B, Hkv, MB*bs, Dh] gather
@@ -449,9 +462,19 @@ def paged_flash_decode(
     already-resident tile instead of DMAing trash — per-slot bandwidth
     is O(live blocks), the paged-attention point. Query groups
     narrower than 8 rows are zero-padded to the TPU sublane tile and
-    sliced back."""
+    sliced back.
+
+    For the int8 pool (runtime/paged.py kv_dtype="int8") pass
+    scale_k/scale_v [NB, Hkv] f32 — per-(block, head) symmetric
+    scales. They are regular inputs (NOT scalar prefetch: an
+    [NB, Hkv] f32 tensor does not fit SMEM) staged one (1, 1) cell at
+    a time through the same block-table index maps as the K/V tiles,
+    and the kernel dequantizes in VMEM — HBM reads stay int8."""
     b, hq, d = q.shape
     nb, hkv, bs, _ = pool_k.shape
+    if (scale_k is None) != (scale_v is None):
+        raise ValueError("pass both scale_k and scale_v, or neither")
+    quantized = scale_k is not None
     if hq % hkv:
         raise ValueError(f"Hq={hq} must be a multiple of Hkv={hkv}")
     if tables.ndim != 2 or tables.shape[0] != b:
@@ -472,25 +495,41 @@ def paged_flash_decode(
         block_size=bs,
         window=window,
         num_tb=mb,
+        quantized=quantized,
     )
 
     def kv_index(i, j, tb, tables_ref, pos_ref):
         lo, hi = _decode_lo_hi(pos_ref[i], bs, window)
         return (tables_ref[i, jnp.clip(tb, lo, hi)], j, 0, 0)
 
+    def scale_index(i, j, tb, tables_ref, pos_ref):
+        lo, hi = _decode_lo_hi(pos_ref[i], bs, window)
+        return (tables_ref[i, jnp.clip(tb, lo, hi)], j)
+
     from jax.experimental.pallas import tpu as pltpu
 
+    in_specs = [
+        pl.BlockSpec(
+            (1, 1, g_pad, d),
+            lambda i, j, tb, tables_ref, pos_ref: (i, j, 0, 0),
+        ),
+        pl.BlockSpec((1, 1, bs, d), kv_index),
+        pl.BlockSpec((1, 1, bs, d), kv_index),
+    ]
+    operands = [qg, pool_k, pool_v]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, 1), scale_index),
+            pl.BlockSpec((1, 1), scale_index),
+        ]
+        operands += [
+            jnp.asarray(scale_k, jnp.float32),
+            jnp.asarray(scale_v, jnp.float32),
+        ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, hkv, mb),
-        in_specs=[
-            pl.BlockSpec(
-                (1, 1, g_pad, d),
-                lambda i, j, tb, tables_ref, pos_ref: (i, j, 0, 0),
-            ),
-            pl.BlockSpec((1, 1, bs, d), kv_index),
-            pl.BlockSpec((1, 1, bs, d), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, 1, g_pad, d),
             lambda i, j, tb, tables_ref, pos_ref: (i, j, 0, 0),
@@ -506,7 +545,7 @@ def paged_flash_decode(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, hkv, g_pad, d), q.dtype),
         interpret=interpret,
-    )(tables, pos1, qg, pool_k, pool_v)
+    )(tables, pos1, *operands)
     return out[:, :, :g, :].reshape(b, hq, d)
 
 
@@ -531,17 +570,14 @@ def _paged_prefill_kernel(
     q_ref,
     k_ref,
     v_ref,
-    o_ref,
-    m_scr,
-    l_scr,
-    acc_scr,
-    *,
+    *rest,
     sm_scale: float,
     block_size: int,
     group: int,
     window: int | None,
     num_tb: int,
     t_q: int,
+    quantized: bool,
 ):
     """One (batch, kv-head, table-column) cell of paged flash-PREFILL:
     `_paged_decode_kernel` generalized from one query token to a
@@ -552,7 +588,14 @@ def _paged_prefill_kernel(
     tiles still arrive through the block-table index maps: chunked
     prefill and the speculative verify forward read the pool directly,
     no contiguous gather. Rows padded past T*G attend a superset of
-    live columns and are sliced off by the wrapper."""
+    live columns and are sliced off by the wrapper. With `quantized`,
+    two (1, 1) per-(block, head) scale refs follow k/v and the fold
+    dequantizes int8 tiles in VMEM (see `_paged_decode_kernel`)."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        ks_ref = vs_ref = None
+        o_ref, m_scr, l_scr, acc_scr = rest
     tb = pl.program_id(2)
     p0 = start_ref[pl.program_id(0)]
     lo, hi = _prefill_lo_hi(p0, t_q, block_size, window)
@@ -569,6 +612,9 @@ def _paged_prefill_kernel(
         r = q.shape[0]
         k = k_ref[0, 0].astype(jnp.float32)  # (block_size, d)
         v = v_ref[0, 0].astype(jnp.float32)
+        if quantized:
+            k = k * ks_ref[0, 0]
+            v = v * vs_ref[0, 0]
         s = lax.dot_general(
             q,
             k,
@@ -615,6 +661,8 @@ def paged_flash_prefill(
     *,
     window: int | None = None,
     interpret: bool = False,
+    scale_k: jax.Array | None = None,
+    scale_v: jax.Array | None = None,
 ) -> jax.Array:
     """Paged flash-prefill: a window of T query tokens per slot
     attending its block table directly — the prefill/verify companion
@@ -635,9 +683,13 @@ def paged_flash_prefill(
     are never read. The T*G query rows are zero-padded to the TPU
     sublane tile and sliced back; tables/start ride scalar prefetch so
     dead columns clamp onto live tiles exactly like the decode
-    kernel."""
+    kernel. scale_k/scale_v [NB, Hkv] f32 enable the int8-pool path —
+    same contract as `paged_flash_decode`."""
     b, hq, t_q, d = q.shape
     nb, hkv, bs, _ = pool_k.shape
+    if (scale_k is None) != (scale_v is None):
+        raise ValueError("pass both scale_k and scale_v, or neither")
+    quantized = scale_k is not None
     if hq % hkv:
         raise ValueError(f"Hq={hq} must be a multiple of Hkv={hkv}")
     if tables.ndim != 2 or tables.shape[0] != b:
@@ -669,25 +721,41 @@ def paged_flash_prefill(
         window=window,
         num_tb=mb,
         t_q=t_q,
+        quantized=quantized,
     )
 
     def kv_index(i, j, tb, tables_ref, start_ref):
         lo, hi = _prefill_lo_hi(start_ref[i], t_q, bs, window)
         return (tables_ref[i, jnp.clip(tb, lo, hi)], j, 0, 0)
 
+    def scale_index(i, j, tb, tables_ref, start_ref):
+        lo, hi = _prefill_lo_hi(start_ref[i], t_q, bs, window)
+        return (tables_ref[i, jnp.clip(tb, lo, hi)], j)
+
     from jax.experimental.pallas import tpu as pltpu
 
+    in_specs = [
+        pl.BlockSpec(
+            (1, 1, r_pad, d),
+            lambda i, j, tb, tables_ref, start_ref: (i, j, 0, 0),
+        ),
+        pl.BlockSpec((1, 1, bs, d), kv_index),
+        pl.BlockSpec((1, 1, bs, d), kv_index),
+    ]
+    operands = [qg, pool_k, pool_v]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, 1), scale_index),
+            pl.BlockSpec((1, 1), scale_index),
+        ]
+        operands += [
+            jnp.asarray(scale_k, jnp.float32),
+            jnp.asarray(scale_v, jnp.float32),
+        ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, hkv, mb),
-        in_specs=[
-            pl.BlockSpec(
-                (1, 1, r_pad, d),
-                lambda i, j, tb, tables_ref, start_ref: (i, j, 0, 0),
-            ),
-            pl.BlockSpec((1, 1, bs, d), kv_index),
-            pl.BlockSpec((1, 1, bs, d), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, 1, r_pad, d),
             lambda i, j, tb, tables_ref, start_ref: (i, j, 0, 0),
@@ -703,7 +771,7 @@ def paged_flash_prefill(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, hkv, r_pad, d), q.dtype),
         interpret=interpret,
-    )(tables, start1, qg, pool_k, pool_v)
+    )(tables, start1, *operands)
     out = out[:, :, :r, :].reshape(b, hkv, t_q, g, d)
     return out.transpose(0, 1, 3, 2, 4).reshape(b, hq, t_q, d)
 

@@ -31,6 +31,7 @@ import zlib
 
 import numpy as np
 
+from defer_tpu.models.quant import dequantize_symmetric, quantize_symmetric
 from defer_tpu.obs.metrics import get_registry
 from defer_tpu.utils.logging import get_logger
 
@@ -156,14 +157,12 @@ def encode(
                 "quantize='int8' requires finite values; tensor contains "
                 "NaN/Inf — send it losslessly instead"
             )
-        scale = amax / 127.0 if amax > 0 else 1.0
-        if scale == 0.0:
-            # amax was a subnormal tiny enough that amax/127 underflows
-            # to 0.0; dividing by it would turn the whole tensor into
-            # clipped +/-127 garbage. Values this small round to zero
-            # at int8 precision anyway.
-            scale = 1.0
-        q = np.clip(np.rint(a64 / scale), -127, 127).astype(np.int8)
+        # Per-tensor symmetric int8 through the ONE shared convention
+        # (models/quant.py): s = amax/127, with degenerate scales
+        # (zero tensor, or amax/127 underflowing to 0.0) clamped to
+        # 1.0 so subnormal inputs don't become clipped +/-127 garbage.
+        q, s = quantize_symmetric(a64, axis=None, xp=np)
+        scale = float(s)
         # _count=False: the inner int8 frame is an implementation
         # detail of THIS encode — letting it count would double-book
         # the raw bytes and understate the compression ratio.
@@ -234,7 +233,9 @@ def decode(frame: bytes) -> np.ndarray:
     if scheme == SCHEME_Q8:
         (scale,) = struct.unpack_from("<d", payload, 0)
         q = decode(payload[8:])
-        return (q.astype(np.float64) * scale).astype(dtype)
+        return dequantize_symmetric(q, scale, np.float64, xp=np).astype(
+            dtype
+        )
     nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize if ndim else dtype.itemsize
     nbytes = max(nbytes, 0)
     elem = dtype.itemsize

@@ -71,6 +71,7 @@ allocated blocks in one jitted op.
 from __future__ import annotations
 
 import hashlib
+import queue
 import threading
 import time
 from typing import Any
@@ -84,11 +85,105 @@ from defer_tpu.models.gpt import (
     sample_token_batched,
     sample_token_batched_nosort,
 )
+from defer_tpu.models.quant import (
+    dequantize_symmetric,
+    quantize_symmetric,
+)
 from defer_tpu.obs.serving import ServerStats, ServingMetrics
 from defer_tpu.ops.pallas_attention import _MASK_VALUE
 from defer_tpu.runtime.batching import accept_lengths, window_drain_order
 from defer_tpu.runtime.decode_server import DraftLanes, SlotSampler
 from defer_tpu.runtime.stopping import matcher_or_none, normalize_stops
+
+
+def _pool_arr(pool):
+    """The array leaf carrying the pool geometry ([.., NB, Hkv, bs,
+    Dh]): the int8 payload of a quantized {"q","s"} pool, or the fp
+    pool itself."""
+    return pool["q"] if isinstance(pool, dict) else pool
+
+
+def _pool_gather(pool_l, idx, dtype):
+    """Gather per-layer pool blocks at `idx` and widen to `dtype`.
+    `pool_l` is [NB, Hkv, bs, Dh] — a plain fp array, or an int8
+    {"q","s"} pair with [NB, Hkv] per-(block, head) scales
+    (models/quant.py convention). The scale folds in AT THE GATHER,
+    so every attend path downstream sees ordinary fp blocks and the
+    attention math stays exactly the fp path's. idx may be [B] (one
+    block per slot) or [B, MB] (a whole table): s broadcasts as
+    s[..., None, None] against q's trailing (bs, Dh) in either case."""
+    if isinstance(pool_l, dict):
+        return dequantize_symmetric(
+            pool_l["q"][idx], pool_l["s"][idx][..., None, None], dtype
+        )
+    return pool_l[idx].astype(dtype)
+
+
+def _pool_write_rows(pool_l, dest, rowi, val):
+    """Scatter one fresh K/V row per batch entry into a per-layer
+    pool slice: dest [N] block ids, rowi [N] rows-in-block, val
+    [N, Hkv, Dh]. For an fp pool this is exactly the historical
+    `.at[dest, :, rowi, :].set(val)` single-row scatter.
+
+    An int8 pool can't write a row in place — symmetric int8 keeps
+    ONE scale per (block, head), so landing a row means re-deriving
+    the block scale: gather the touched blocks, dequantize, insert
+    the new row, ZERO the stale rows past it (rows > rowi are a
+    previous tenant's garbage; folding them into amax would blow up
+    the scale and crush the live rows' precision — in fp they hide
+    behind the position mask, here they'd poison the whole block),
+    re-quantize over (bs, Dh), scatter payload + scale back.
+    Duplicate dest entries (trash block 0) race over garbage, the
+    module invariant; radix-shared blocks are never a live dest, so
+    no other request's scale is ever perturbed."""
+    if not isinstance(pool_l, dict):
+        return pool_l.at[dest, :, rowi, :].set(val)
+    n = dest.shape[0]
+    bs = pool_l["q"].shape[2]
+    blk = dequantize_symmetric(
+        pool_l["q"][dest],
+        pool_l["s"][dest][..., None, None],
+        jnp.float32,
+    )  # [N, Hkv, bs, Dh]
+    blk = blk.at[jnp.arange(n), :, rowi, :].set(val.astype(jnp.float32))
+    live = jnp.arange(bs)[None, :] <= rowi[:, None]  # [N, bs]
+    blk = blk * live[:, None, :, None]
+    q, s = quantize_symmetric(blk, axis=(-2, -1))  # s [N, Hkv]
+    return {
+        "q": pool_l["q"].at[dest].set(q),
+        "s": pool_l["s"].at[dest].set(s),
+    }
+
+
+def _pool_write_rows_mt(pool_l, dest, rowi, val):
+    """Multi-token sibling of _pool_write_rows: dest/rowi [B, T], val
+    [B, T, Hkv, Dh] (T fresh rows per slot — a verify span or a
+    prefill chunk). The fp path keeps the one-shot multi-row scatter.
+    The int8 path loops the T columns SEQUENTIALLY through the
+    single-row write: consecutive rows of one slot land in the same
+    block, so each write must see the previous one's payload and
+    scale — a parallel gather/requant would drop its siblings' rows.
+    T is a small static bound (spec_k + 1, or a prefill chunk), and
+    positions ascend with t, so the stale-row zeroing stays exact."""
+    if not isinstance(pool_l, dict):
+        return pool_l.at[dest, :, rowi, :].set(val)
+    t = dest.shape[1]
+
+    def body(j, pool):
+        return _pool_write_rows(
+            pool, dest[:, j], rowi[:, j], val[:, j]
+        )
+
+    return lax.fori_loop(0, t, body, pool_l)
+
+
+def _quantize_blocks(blocks):
+    """[L, n, Hkv, bs, Dh] fp block stack -> ({"q","s"}) int8 payload
+    + [L, n, Hkv] scales, the pool's storage convention."""
+    q, s = quantize_symmetric(
+        blocks.astype(jnp.float32), axis=(-2, -1)
+    )
+    return q, s
 
 
 def _blockwise_attend(q, pk_l, pv_l, tables, pos, bs, nb_live, window):
@@ -110,7 +205,7 @@ def _blockwise_attend(q, pk_l, pv_l, tables, pos, bs, nb_live, window):
     the gathered path's one-pass einsum up to reduction order —
     tie-tolerant, not bit-exact (module docstring)."""
     b, hq, _, dh = q.shape
-    hkv = pk_l.shape[1]
+    hkv = _pool_arr(pk_l).shape[1]
     g = hq // hkv
     qg = q[:, :, 0, :].reshape(b, hkv, g, dh).astype(jnp.float32)
     qg = qg * (dh**-0.5)
@@ -119,8 +214,8 @@ def _blockwise_attend(q, pk_l, pv_l, tables, pos, bs, nb_live, window):
     def body(j, carry):
         m, l, acc = carry
         blk = tables[:, j]  # [B]
-        k = pk_l[blk].astype(jnp.float32)  # [B, Hkv, bs, Dh]
-        v = pv_l[blk].astype(jnp.float32)
+        k = _pool_gather(pk_l, blk, jnp.float32)  # [B, Hkv, bs, Dh]
+        v = _pool_gather(pv_l, blk, jnp.float32)
         s = jnp.einsum("bkgd,bksd->bkgs", qg, k)
         cols = j * bs + span  # [bs]
         mask = cols[None, :] <= pos[:, None]  # [B, bs]
@@ -160,7 +255,7 @@ def _blockwise_attend_mt(q, pk_l, pv_l, tables, pos, bs, nb_live, window):
     q.dtype, the layout _attn_out takes. Same tie-tolerant contract as
     the single-token fold."""
     b, hq, t, dh = q.shape
-    hkv = pk_l.shape[1]
+    hkv = _pool_arr(pk_l).shape[1]
     g = hq // hkv
     qg = q.reshape(b, hkv, g, t, dh).astype(jnp.float32)
     qg = qg * (dh**-0.5)
@@ -170,8 +265,8 @@ def _blockwise_attend_mt(q, pk_l, pv_l, tables, pos, bs, nb_live, window):
     def body(j, carry):
         m, l, acc = carry
         blk = tables[:, j]  # [B]
-        k = pk_l[blk].astype(jnp.float32)  # [B, Hkv, bs, Dh]
-        v = pv_l[blk].astype(jnp.float32)
+        k = _pool_gather(pk_l, blk, jnp.float32)  # [B, Hkv, bs, Dh]
+        v = _pool_gather(pv_l, blk, jnp.float32)
         s = jnp.einsum("bkgtd,bksd->bkgts", qg, k)
         cols = j * bs + span  # [bs]
         mask = cols[None, None, :] <= qpos[:, :, None]  # [B, T, bs]
@@ -199,6 +294,112 @@ def _blockwise_attend_mt(q, pk_l, pv_l, tables, pos, bs, nb_live, window):
         .reshape(b, t, hq * dh)
         .astype(q.dtype)
     )
+
+
+class HostKVSpill:
+    """Bounded host-RAM spill tier for evicted prefix blocks: under
+    pool pressure `PrefixBlockCache.evict` forgets warm blocks, and a
+    later radix hit becomes a full re-prefill. This store keeps the
+    evicted payload (already-quantized int8 + scale, or the fp bytes
+    on an fp pool) keyed by the block's chained digest, so a *spill
+    hit* revives the block into the pool with its EXACT stored bytes
+    — token-identical to a resident hit — instead of recomputing it.
+
+    Mutation domains (the disagg/ingest.py split, applied to spill):
+
+      * the SERVING thread only enqueues device-array slices
+        (`offer`, async dispatch — no blocking copy on the tick path)
+        and reads/touches the store under `_lock` (`get`);
+      * the DRAIN thread owns every blocking device->host copy and
+        all insert/trim mutation of the store (under the same lock).
+
+    The store is byte-bounded: inserts trim oldest-first (dict order
+    is insertion order; `get` re-inserts on hit, so it is LRU). The
+    offer queue is bounded too — under a burst of evictions spill is
+    best-effort and sheds, never backpressuring admission. The race
+    where a revival looks up a block that was evicted but not yet
+    drained simply misses (a normal re-prefill), never corrupts."""
+
+    def __init__(self, cap_bytes: int, obs: Any = None):
+        self.cap = int(cap_bytes)
+        self._q: queue.Queue = queue.Queue(maxsize=256)
+        # key -> (own-block token bytes, host payload tuple, nbytes)
+        self._store: dict[bytes, tuple] = {}
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self._obs = obs
+        self._thread = threading.Thread(
+            target=self._drain_loop, name="kv-spill-drain", daemon=True
+        )
+        self._thread.start()
+
+    def offer(self, key: bytes, tok: bytes, arrays: tuple) -> None:
+        """Serving thread: hand over async device slices of an
+        evicted block. Never blocks — a full queue sheds the spill
+        (the block is simply lost to the tier, as before this tier
+        existed)."""
+        try:
+            self._q.put_nowait((key, tok, arrays))
+        except queue.Full:
+            pass
+
+    def _drain_loop(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                self._q.task_done()
+                return
+            key, tok, arrays = item
+            # The blocking device->host copies, off the tick path.
+            host = tuple(np.asarray(a) for a in arrays)
+            nbytes = sum(a.nbytes for a in host)
+            with self._lock:
+                old = self._store.pop(key, None)
+                if old is not None:
+                    self._bytes -= old[2]
+                self._store[key] = (tok, host, nbytes)
+                self._bytes += nbytes
+                while self._bytes > self.cap and self._store:
+                    k0 = next(iter(self._store))
+                    _, _, nb0 = self._store.pop(k0)
+                    self._bytes -= nb0
+                stored_bytes = self._bytes
+            if self._obs is not None:
+                self._obs.prefix_spilled.inc()
+                self._obs.spill_bytes.set(stored_bytes)
+            self._q.task_done()
+
+    def get(self, key: bytes, tok: bytes) -> tuple | None:
+        """Serving thread: the spill lookup on a radix walk miss.
+        Token-byte guarded like every radix hit (collision
+        discipline); a hit is LRU-touched and its host payload
+        returned for re-upload. The entry stays resident — the block
+        may be evicted again later."""
+        with self._lock:
+            ent = self._store.get(key)
+            if ent is None or ent[0] != tok:
+                return None
+            self._store[key] = self._store.pop(key)  # LRU touch
+            return ent[1]
+
+    def flush(self) -> None:
+        """Block until every offered payload has drained into the
+        store (tests / bench determinism; never on the tick path)."""
+        self._q.join()
+
+    @property
+    def stored_blocks(self) -> int:
+        with self._lock:
+            return len(self._store)
+
+    @property
+    def stored_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def close(self) -> None:
+        self._q.put(None)
+        self._thread.join(timeout=5)
 
 
 class PrefixBlockCache:
@@ -233,7 +434,12 @@ class PrefixBlockCache:
     miss counts are the admitting server's job (it knows whether an
     admission sticks)."""
 
-    def __init__(self, obs: Any = None):
+    def __init__(self, obs: Any = None, on_evict: Any = None):
+        # `on_evict(key, tok, blk)` — optional spill hook, called on
+        # the evicting (serving) thread BEFORE the block is forgotten,
+        # while its pool payload is still addressable: the server's
+        # spill path snapshots the block for HostKVSpill there.
+        self._on_evict = on_evict
         self.by_key: dict[bytes, int] = {}
         self.ref: dict[int, int] = {}
         self.key_of: dict[int, bytes] = {}
@@ -346,6 +552,8 @@ class PrefixBlockCache:
         out = []
         while self.lru and len(out) < n:
             blk = next(iter(self.lru))
+            if self._on_evict is not None:
+                self._on_evict(self.key_of[blk], self.tok_of[blk], blk)
             del self.lru[blk]
             with self._lock:
                 del self.by_key[self.key_of.pop(blk)]
@@ -404,6 +612,8 @@ class PagedDecodeServer:
         prefix_ids: jax.Array | None = None,
         prefix_cache: bool = False,
         attention: str = "gathered",
+        kv_dtype: str = "fp",
+        spill_bytes: int = 0,
         decode_window: int = 1,
         spec_draft: Any = None,
         spec_params: dict | None = None,
@@ -457,6 +667,28 @@ class PagedDecodeServer:
         allocation/release stay at window boundaries. The default 1 is
         the classic tick-per-token loop, bit-identical to before.
 
+        `kv_dtype` — the pool's storage dtype. "fp" (default) keeps
+        the compute-dtype pool, bit-identical to before the knob
+        existed. "int8" stores K/V rows as symmetric int8 with ONE
+        fp32 scale per (layer, block, kv_head) — half the HBM bytes
+        of a bf16 pool — quantizing inside the same jitted scatters
+        that land KV today and dequantizing on read in all three
+        `attention` modes (the pallas kernels take the int8 pool plus
+        its scale refs, so read traffic halves too). Greedy output is
+        NOT bit-identical to fp — the accuracy contract is the
+        bounded logit-error parity pinned in tests/test_kv_quant.py.
+
+        `spill_bytes` — host-RAM spill tier for evicted prefix blocks
+        (requires prefix_cache=True): when the radix cache evicts a
+        parked block under pool pressure, its payload (quantized rows
+        + scales for int8; compute-dtype rows for fp) is snapshotted
+        asynchronously and drained to a bounded host store keyed by
+        the block's chain digest, off the tick hot path (same
+        drain-thread shape as disagg/ingest.py). A later walk miss
+        that hits the spill store revives the block into the pool
+        token-identically to a resident radix hit instead of
+        re-prefilling. 0 (default) disables the tier.
+
         `attention` — which decode attention path the tick compiles
         (module docstring): "gathered" (contiguous-view reference,
         bit-exact, the default), "blockwise" (pure-XLA block-native,
@@ -508,6 +740,20 @@ class PagedDecodeServer:
             raise ValueError(
                 f"attention must be 'gathered', 'blockwise' or "
                 f"'pallas', got {attention!r}"
+            )
+        if kv_dtype not in ("fp", "int8"):
+            raise ValueError(
+                f"kv_dtype must be 'fp' or 'int8', got {kv_dtype!r}"
+            )
+        if spill_bytes < 0:
+            raise ValueError(
+                f"spill_bytes must be >= 0, got {spill_bytes}"
+            )
+        if spill_bytes and not prefix_cache:
+            raise ValueError(
+                "spill_bytes > 0 needs prefix_cache=True — the spill "
+                "tier stores evicted PREFIX blocks keyed by the radix "
+                "cache's chain digests"
             )
         if decode_window < 1:
             raise ValueError(
@@ -650,9 +896,17 @@ class PagedDecodeServer:
         # Max logical blocks any sequence can span.
         self.MB = -(-cfg.max_len // block_size)
         dh = cfg.dim // cfg.num_heads
+        self.kv_dtype = kv_dtype
+        self.num_blocks = num_blocks
         pool_shape = (
             cfg.num_layers, num_blocks, cfg.kv_heads, block_size, dh,
         )
+        # int8 pools are a {"q", "s"} pytree: int8 rows plus one fp32
+        # scale per (layer, block, kv_head). Scales start at 1.0 so a
+        # never-written block dequantizes to the zeros an fp pool
+        # holds. The fp pool stays a PLAIN array — its jitted
+        # programs trace byte-identical to pre-int8 builds.
+        scale_shape = (cfg.num_layers, num_blocks, cfg.kv_heads)
         if mesh is not None:
             from jax.sharding import NamedSharding
             from jax.sharding import PartitionSpec as PSpec
@@ -662,24 +916,62 @@ class PagedDecodeServer:
             # block present on every shard, but only its local heads.
             # Allocated DIRECTLY sharded (no transient replicated
             # pool), params placed by the Megatron specs (vocab table
-            # padded to a tp multiple by shard_params).
+            # padded to a tp multiple by shard_params). The int8
+            # scale tensor splits on the SAME head axis (index 2 in
+            # both layouts), so a shard's rows and scales travel
+            # together.
             self._pool_spec = PSpec(None, None, model_axis, None, None)
+            self._head_spec = PSpec(None, None, model_axis)
             pool_sh = NamedSharding(mesh, self._pool_spec)
-            self.pool_k = jnp.zeros(
-                pool_shape, dec.compute_dtype, device=pool_sh
-            )
-            self.pool_v = jnp.zeros(
-                pool_shape, dec.compute_dtype, device=pool_sh
-            )
+            if kv_dtype == "int8":
+                scale_sh = NamedSharding(mesh, self._head_spec)
+                self.pool_k = {
+                    "q": jnp.zeros(pool_shape, jnp.int8, device=pool_sh),
+                    "s": jnp.ones(scale_shape, jnp.float32, device=scale_sh),
+                }
+                self.pool_v = {
+                    "q": jnp.zeros(pool_shape, jnp.int8, device=pool_sh),
+                    "s": jnp.ones(scale_shape, jnp.float32, device=scale_sh),
+                }
+            else:
+                self.pool_k = jnp.zeros(
+                    pool_shape, dec.compute_dtype, device=pool_sh
+                )
+                self.pool_v = jnp.zeros(
+                    pool_shape, dec.compute_dtype, device=pool_sh
+                )
             self.params = self._sdec.shard_params(params)
         else:
             self._pool_spec = None
-            self.pool_k = jnp.zeros(pool_shape, dec.compute_dtype)
-            self.pool_v = jnp.zeros(pool_shape, dec.compute_dtype)
+            self._head_spec = None
+            if kv_dtype == "int8":
+                self.pool_k = {
+                    "q": jnp.zeros(pool_shape, jnp.int8),
+                    "s": jnp.ones(scale_shape, jnp.float32),
+                }
+                self.pool_v = {
+                    "q": jnp.zeros(pool_shape, jnp.int8),
+                    "s": jnp.ones(scale_shape, jnp.float32),
+                }
+            else:
+                self.pool_k = jnp.zeros(pool_shape, dec.compute_dtype)
+                self.pool_v = jnp.zeros(pool_shape, dec.compute_dtype)
             if device is not None:
                 self.pool_k = jax.device_put(self.pool_k, device)
                 self.pool_v = jax.device_put(self.pool_v, device)
                 self.params = jax.device_put(params, device)
+        # shard_map / with_sharding_constraint spec matching the
+        # pool's pytree structure (plain spec for fp, {"q","s"} tree
+        # for int8).
+        self._pool_specs = (
+            {"q": self._pool_spec, "s": self._head_spec}
+            if kv_dtype == "int8"
+            else self._pool_spec
+        )
+        self.pool_bytes = sum(
+            leaf.nbytes
+            for leaf in jax.tree.leaves((self.pool_k, self.pool_v))
+        )
         # Block 0 is trash: unallocated table entries point at it.
         self.free = list(range(1, num_blocks))
         self.tables = np.zeros((max_batch, self.MB), np.int32)
@@ -714,6 +1006,7 @@ class PagedDecodeServer:
         # Metric handles resolved once; tick/admission paths touch
         # pre-bound attributes only (obs/serving.py).
         self.obs = ServingMetrics("paged", mesh_shape=self.mesh_label)
+        self.obs.kv_pool_bytes.set(self.pool_bytes)
         self._submit_t: dict[int, float] = {}
         self._last_tick_t: float | None = None
         self._step = None
@@ -721,6 +1014,7 @@ class PagedDecodeServer:
         self._insert_dyn = None
         self._import = None
         self._mt = None
+        self._spill_up = None
         self.spec_k = spec_k
         self.prefill_chunk = prefill_chunk
         # Draft lanes (runtime/decode_server.py::DraftLanes): the
@@ -741,6 +1035,8 @@ class PagedDecodeServer:
         self.radix: PrefixBlockCache | None = None
         self._gather = None
         self.prefill_tokens_saved = 0
+        self._spill: HostKVSpill | None = None
+        self.spill_hits_n = 0
         if prefix_cache:
             if prefix_ids is not None:
                 raise ValueError(
@@ -753,7 +1049,14 @@ class PagedDecodeServer:
                     "prefix_cache + multi-LoRA is unsupported: cached "
                     "prefix K/V would be adapter-dependent"
                 )
-            self.radix = PrefixBlockCache(obs=self.obs)
+            if spill_bytes:
+                self._spill = HostKVSpill(spill_bytes, obs=self.obs)
+            self.radix = PrefixBlockCache(
+                obs=self.obs,
+                on_evict=(
+                    self._spill_block if self._spill is not None else None
+                ),
+            )
         if prefix_ids is not None:
             if self.multi_lora:
                 raise ValueError(
@@ -789,7 +1092,7 @@ class PagedDecodeServer:
 
             full_insert = cached_step(
                 dec,
-                ("paged_insert", block_size, 0, self._mesh_key),
+                ("paged_insert", block_size, 0, kv_dtype, self._mesh_key),
                 lambda: self._build_insert(0),
             )
             fdec = self._sdec if self._sdec is not None else dec
@@ -872,7 +1175,7 @@ class PagedDecodeServer:
                 f"{self.dec.cfg.max_len}"
             )
         need = self._own_need(t0, num_steps)
-        usable = self.pool_k.shape[1] - 1 - len(self.shared_blocks)
+        usable = self.num_blocks - 1 - len(self.shared_blocks)
         if need > usable:
             # Not even an empty pool could hold it — waiting would
             # deadlock the queue.
@@ -950,7 +1253,7 @@ class PagedDecodeServer:
                 f"{self.dec.cfg.max_len}"
             )
         need = self._own_need(t0, num_steps)
-        usable = self.pool_k.shape[1] - 1
+        usable = self.num_blocks - 1
         if need > usable:
             raise ValueError(
                 f"request needs {need} blocks but the pool has "
@@ -1030,7 +1333,7 @@ class PagedDecodeServer:
             # (shared blocks counted once, however many slots point at
             # them).
             return (
-                (int(self.pool_k.shape[1]) - 1)
+                (self.num_blocks - 1)
                 - len(self.free)
                 - len(self.radix.lru)
             )
@@ -1072,13 +1375,30 @@ class PagedDecodeServer:
         # analysis: ignore[host-sync-in-hot-loop] host-side block-id
         # list becoming device gather indices — no device readback
         idx = jnp.asarray(np.asarray(blks, np.int32))
+        if isinstance(self.pool_k, dict):
+            # int8 pools dequantize before export: the migration wire
+            # format stays the compute-dtype block stack regardless of
+            # either end's kv_dtype.
+            kd = dequantize_symmetric(
+                self.pool_k["q"][:, idx],
+                self.pool_k["s"][:, idx][..., None, None],
+                self.dec.compute_dtype,
+            )
+            vd = dequantize_symmetric(
+                self.pool_v["q"][:, idx],
+                self.pool_v["s"][:, idx][..., None, None],
+                self.dec.compute_dtype,
+            )
+        else:
+            kd = self.pool_k[:, idx]
+            vd = self.pool_v[:, idx]
         # analysis: ignore[host-sync-in-hot-loop] deliberate sync — a
         # migration ships the payload over a host wire, so the copy to
         # host memory IS the operation
-        k = np.asarray(self.pool_k[:, idx])
+        k = np.asarray(kd)
         # analysis: ignore[host-sync-in-hot-loop] second half of the
         # same deliberate migration copy
-        v = np.asarray(self.pool_v[:, idx])
+        v = np.asarray(vd)
         return toks, k, v
 
     def _shard_ingest(self, arr) -> jax.Array:
@@ -1088,14 +1408,18 @@ class PagedDecodeServer:
         the [L, n, Hkv, bs, Dh] block-stack and [L, 1, Hkv, S, Dh]
         lane layouts) as it lands on device, so each shard receives
         only its local heads and the wire/lane format never changes.
-        On a pinned single device it lands there; otherwise this is
-        plain jnp.asarray."""
+        3-D arrays are int8 block SCALES ([L, n, Hkv]) — same head
+        axis, scale-rank spec. On a pinned single device it lands
+        there; otherwise this is plain jnp.asarray."""
         if self.mesh is not None:
             from jax.sharding import NamedSharding
 
-            return jax.device_put(
-                arr, NamedSharding(self.mesh, self._pool_spec)
+            spec = (
+                self._head_spec
+                if getattr(arr, "ndim", 5) == 3
+                else self._pool_spec
             )
+            return jax.device_put(arr, NamedSharding(self.mesh, spec))
         if self.device is not None:
             return jax.device_put(arr, self.device)
         return jnp.asarray(arr)
@@ -1109,14 +1433,31 @@ class PagedDecodeServer:
                     # Pad entries in dest are 0: duplicate writes to
                     # trash block 0 race over garbage, by the module
                     # invariant.
-                    pk = pk.at[:, dest].set(k_blocks)
-                    pv = pv.at[:, dest].set(v_blocks)
+                    if isinstance(pk, dict):
+                        # Imported stacks arrive compute-dtype on the
+                        # wire; quantize per (layer, block, head) as
+                        # they land (imported blocks are always FULL —
+                        # every row real prompt content).
+                        kq, ks = _quantize_blocks(k_blocks)
+                        vq, vs = _quantize_blocks(v_blocks)
+                        pk = {
+                            "q": pk["q"].at[:, dest].set(kq),
+                            "s": pk["s"].at[:, dest].set(ks),
+                        }
+                        pv = {
+                            "q": pv["q"].at[:, dest].set(vq),
+                            "s": pv["s"].at[:, dest].set(vs),
+                        }
+                    else:
+                        pk = pk.at[:, dest].set(k_blocks)
+                        pv = pv.at[:, dest].set(v_blocks)
                     return self._pool_constraint(pk, pv)
 
                 return jax.jit(imp, donate_argnums=(0, 1))
 
             self._import = cached_step(
-                self.dec, ("fleet_import", self.bs, self._mesh_key),
+                self.dec,
+                ("fleet_import", self.bs, self.kv_dtype, self._mesh_key),
                 build,
             )
         return self._import
@@ -1226,24 +1567,30 @@ class PagedDecodeServer:
         }
         self._step = cached_step(
             self.dec,
-            ("paged_step", self.bs, self.attention, self._mesh_key),
+            (
+                "paged_step", self.bs, self.attention, self.kv_dtype,
+                self._mesh_key,
+            ),
             builders[self.attention],
         )
         skip = len(self.shared_blocks)
         self._insert = cached_step(
             self.dec,
-            ("paged_insert", self.bs, skip, self._mesh_key),
+            ("paged_insert", self.bs, skip, self.kv_dtype, self._mesh_key),
             lambda: self._build_insert(skip),
         )
         if self.radix is not None and self._gather is None:
             self._gather = cached_step(
                 self.dec,
-                ("paged_gather", self.bs, self._mesh_key),
+                ("paged_gather", self.bs, self.kv_dtype, self._mesh_key),
                 self._build_gather,
             )
             self._insert_dyn = cached_step(
                 self.dec,
-                ("paged_insert_dyn", self.bs, self._mesh_key),
+                (
+                    "paged_insert_dyn", self.bs, self.kv_dtype,
+                    self._mesh_key,
+                ),
                 self._build_insert_dynamic,
             )
 
@@ -1300,7 +1647,7 @@ class PagedDecodeServer:
 
         from defer_tpu.utils.compat import shard_map
 
-        pool, r = self._pool_spec, PSpec()
+        pool, r = self._pool_specs, PSpec()
         sm = shard_map(
             body,
             self.mesh,
@@ -1343,8 +1690,10 @@ class PagedDecodeServer:
                 p, pk_l, pv_l = layer  # [NB, Hkv, bs, Dh]
                 # Gather this slot's pages into the contiguous view
                 # the flat block math expects: [B, Hkv, MB*bs, Dh].
-                kc = pk_l[tables]  # [B, MB, Hkv, bs, Dh]
-                vc = pv_l[tables]
+                # An int8 pool dequantizes AT the gather (scale folds
+                # into the block values), so _block sees fp blocks.
+                kc = _pool_gather(pk_l, tables, dec.compute_dtype)
+                vc = _pool_gather(pv_l, tables, dec.compute_dtype)
                 b_, mb, hkv, _, dh = kc.shape
                 kc = kc.transpose(0, 2, 1, 3, 4).reshape(
                     b_, hkv, mb * bs, dh
@@ -1361,8 +1710,8 @@ class PagedDecodeServer:
                 row = pos % bs
                 new_k = kc[rows, :, pos, :]  # [B, Hkv, Dh]
                 new_v = vc[rows, :, pos, :]
-                pk_l = pk_l.at[blk, :, row, :].set(new_k)
-                pv_l = pv_l.at[blk, :, row, :].set(new_v)
+                pk_l = _pool_write_rows(pk_l, blk, row, new_k)
+                pv_l = _pool_write_rows(pv_l, blk, row, new_v)
                 return out, (pk_l, pv_l)
 
             x, (pk, pv) = lax.scan(
@@ -1407,8 +1756,8 @@ class PagedDecodeServer:
                 q, k_new, v_new = dec._attn_qkv(
                     p, x, pos, adapter_ids=adapter_ids
                 )
-                pk_l = pk_l.at[blk_w, :, row_w, :].set(k_new[:, :, 0, :])
-                pv_l = pv_l.at[blk_w, :, row_w, :].set(v_new[:, :, 0, :])
+                pk_l = _pool_write_rows(pk_l, blk_w, row_w, k_new[:, :, 0, :])
+                pv_l = _pool_write_rows(pv_l, blk_w, row_w, v_new[:, :, 0, :])
                 attn = _blockwise_attend(
                     q, pk_l, pv_l, tables, pos, bs, nb_live, window
                 )
@@ -1457,17 +1806,20 @@ class PagedDecodeServer:
                 q, k_new, v_new = dec._attn_qkv(
                     p, x, pos, adapter_ids=adapter_ids
                 )
-                pk_l = pk_l.at[blk_w, :, row_w, :].set(k_new[:, :, 0, :])
-                pv_l = pv_l.at[blk_w, :, row_w, :].set(v_new[:, :, 0, :])
+                pk_l = _pool_write_rows(pk_l, blk_w, row_w, k_new[:, :, 0, :])
+                pv_l = _pool_write_rows(pv_l, blk_w, row_w, v_new[:, :, 0, :])
                 b_, hq, _, dh = q.shape
+                quantized = isinstance(pk_l, dict)
                 attn = paged_flash_decode(
                     q[:, :, 0, :],
-                    pk_l,
-                    pv_l,
+                    _pool_arr(pk_l),
+                    _pool_arr(pv_l),
                     tables,
                     pos,
                     window=window,
                     interpret=interpret,
+                    scale_k=pk_l["s"] if quantized else None,
+                    scale_v=pv_l["s"] if quantized else None,
                 )  # [B, Hq, Dh]
                 attn = attn.astype(x.dtype).reshape(b_, 1, hq * dh)
                 out = dec._attn_out(
@@ -1496,7 +1848,10 @@ class PagedDecodeServer:
 
             self._mt = cached_step(
                 self.dec,
-                ("paged_mt", self.bs, self.attention, self._mesh_key),
+                (
+                    "paged_mt", self.bs, self.attention, self.kv_dtype,
+                    self._mesh_key,
+                ),
                 lambda: self._jit_tick(self._mt_body(), n_rep=6),
             )
         return self._mt
@@ -1568,8 +1923,8 @@ class PagedDecodeServer:
                 def body(carry, layer):
                     x = carry
                     p, pk_l, pv_l = layer
-                    kc = pk_l[tables]  # [B, MB, Hkv, bs, Dh]
-                    vc = pv_l[tables]
+                    kc = _pool_gather(pk_l, tables, dec.compute_dtype)
+                    vc = _pool_gather(pv_l, tables, dec.compute_dtype)
                     b_, mb_, hkv, _, dh = kc.shape
                     kc = kc.transpose(0, 2, 1, 3, 4).reshape(
                         b_, hkv, mb_ * bs, dh
@@ -1584,8 +1939,8 @@ class PagedDecodeServer:
                     # Multi-row scatter-back: T fresh rows per slot.
                     new_k = kc[rows[:, None], :, pvec, :]
                     new_v = vc[rows[:, None], :, pvec, :]
-                    pk_l = pk_l.at[dest, :, rowi, :].set(new_k)
-                    pv_l = pv_l.at[dest, :, rowi, :].set(new_v)
+                    pk_l = _pool_write_rows_mt(pk_l, dest, rowi, new_k)
+                    pv_l = _pool_write_rows_mt(pv_l, dest, rowi, new_v)
                     return out, (pk_l, pv_l)
 
             elif attention == "blockwise":
@@ -1597,11 +1952,11 @@ class PagedDecodeServer:
                         p, x, pos, adapter_ids=adapter_ids
                     )  # q [B,Hq,T,Dh]; k/v_new [B,Hkv,T,Dh]
                     # Write-then-attend, like every paged step.
-                    pk_l = pk_l.at[dest, :, rowi, :].set(
-                        k_new.transpose(0, 2, 1, 3)
+                    pk_l = _pool_write_rows_mt(
+                        pk_l, dest, rowi, k_new.transpose(0, 2, 1, 3)
                     )
-                    pv_l = pv_l.at[dest, :, rowi, :].set(
-                        v_new.transpose(0, 2, 1, 3)
+                    pv_l = _pool_write_rows_mt(
+                        pv_l, dest, rowi, v_new.transpose(0, 2, 1, 3)
                     )
                     nb_live = jnp.minimum(
                         (jnp.max(pos) + t - 1) // bs + 1, mb
@@ -1623,20 +1978,23 @@ class PagedDecodeServer:
                     q, k_new, v_new = dec._attn_qkv(
                         p, x, pos, adapter_ids=adapter_ids
                     )
-                    pk_l = pk_l.at[dest, :, rowi, :].set(
-                        k_new.transpose(0, 2, 1, 3)
+                    pk_l = _pool_write_rows_mt(
+                        pk_l, dest, rowi, k_new.transpose(0, 2, 1, 3)
                     )
-                    pv_l = pv_l.at[dest, :, rowi, :].set(
-                        v_new.transpose(0, 2, 1, 3)
+                    pv_l = _pool_write_rows_mt(
+                        pv_l, dest, rowi, v_new.transpose(0, 2, 1, 3)
                     )
                     b_, hq, t_, dh = q.shape
+                    quantized = isinstance(pk_l, dict)
                     attn = paged_flash_prefill(
                         q,
-                        pk_l,
-                        pv_l,
+                        _pool_arr(pk_l),
+                        _pool_arr(pv_l),
                         tables,
                         pos,
                         window=window,
+                        scale_k=pk_l["s"] if quantized else None,
+                        scale_v=pv_l["s"] if quantized else None,
                         interpret=interpret,
                     )  # [B, Hq, T, Dh]
                     attn = (
@@ -1737,7 +2095,7 @@ class PagedDecodeServer:
 
             from defer_tpu.utils.compat import shard_map
 
-            pool, r = self._pool_spec, PSpec()
+            pool, r = self._pool_specs, PSpec()
             sm = shard_map(
                 window,
                 self.mesh,
@@ -1750,8 +2108,8 @@ class PagedDecodeServer:
 
         return cached_step(
             self.dec,
-            ("paged_window", self.bs, self.attention, K, mode, eos,
-             self._mesh_key),
+            ("paged_window", self.bs, self.attention, self.kv_dtype,
+             K, mode, eos, self._mesh_key),
             build,
         )
 
@@ -1762,15 +2120,21 @@ class PagedDecodeServer:
         ordinary GSPMD jits — XLA partitions the scatters — but the
         constraint stops the partitioner from ever materializing a
         gathered pool. No-op on mesh=None. All these layouts carry
-        their head axis at index 2, so one spec serves them all."""
+        their head axis at index 2 — rank picks between the 5-D
+        pool/lane spec and the 3-D int8 scale spec, and a {"q","s"}
+        pool pytree pins per leaf."""
         if self.mesh is None:
             return arrays if len(arrays) > 1 else arrays[0]
         from jax.sharding import NamedSharding
 
-        sh = NamedSharding(self.mesh, self._pool_spec)
-        out = tuple(
-            lax.with_sharding_constraint(a, sh) for a in arrays
-        )
+        pool_sh = NamedSharding(self.mesh, self._pool_spec)
+        head_sh = NamedSharding(self.mesh, self._head_spec)
+
+        def pin(leaf):
+            sh = head_sh if leaf.ndim == 3 else pool_sh
+            return lax.with_sharding_constraint(leaf, sh)
+
+        out = tuple(jax.tree.map(pin, a) for a in arrays)
         return out if len(out) > 1 else out[0]
 
     def _build_insert(self, skip: int = 0):
@@ -1810,8 +2174,24 @@ class PagedDecodeServer:
             # blocks (their rows in the small cache are identical by
             # construction, but they are not this request's to touch).
             dest = table_row[skip:]
-            pk = pk.at[:, dest].set(k_blocks[:, skip:])
-            pv = pv.at[:, dest].set(v_blocks[:, skip:])
+            if isinstance(pk, dict):
+                # Quantize as the blocks land. Lane rows past the
+                # prompt are ZEROS here (flat prefill writes into an
+                # init_cache-zeroed lane), so the block scales see
+                # only real content.
+                kq, ks = _quantize_blocks(k_blocks[:, skip:])
+                vq, vs = _quantize_blocks(v_blocks[:, skip:])
+                pk = {
+                    "q": pk["q"].at[:, dest].set(kq),
+                    "s": pk["s"].at[:, dest].set(ks),
+                }
+                pv = {
+                    "q": pv["q"].at[:, dest].set(vq),
+                    "s": pv["s"].at[:, dest].set(vs),
+                }
+            else:
+                pk = pk.at[:, dest].set(k_blocks[:, skip:])
+                pv = pv.at[:, dest].set(v_blocks[:, skip:])
             return self._pool_constraint(pk, pv)
 
         return jax.jit(insert, donate_argnums=(0, 1))
@@ -1824,10 +2204,19 @@ class PagedDecodeServer:
         equivalent, not guaranteed bit-identical, so rewriting them
         would perturb concurrent readers — hence their writes are
         redirected to trash block 0 (duplicate trash writes race over
-        garbage, by the module invariant)."""
+        garbage, by the module invariant).
+
+        `valid` (runtime scalar, int8 pools only) — the count of REAL
+        lane rows. A radix admission's lane is gathered from the pool,
+        so rows past the prompt are a previous tenant's garbage (not
+        the zeros a flat-prefill lane carries); folding them into a
+        block's amax would inflate its scale and crush the live rows'
+        precision, so the int8 path zeroes rows >= valid before
+        quantizing. The fp path ignores it (garbage hides behind the
+        position mask, and touching it would break bit-identity)."""
         bs = self.bs
 
-        def insert(pk, pv, small_k, small_v, table_row, skip):
+        def insert(pk, pv, small_k, small_v, table_row, skip, valid):
             mb = table_row.shape[0]
             s_need = mb * bs
             k_rows = small_k[:, 0]
@@ -1844,6 +2233,12 @@ class PagedDecodeServer:
                 k_rows = k_rows[:, :, :s_need]
                 v_rows = v_rows[:, :, :s_need]
             L, hkv, _, dh = k_rows.shape
+            if isinstance(pk, dict):
+                live = (jnp.arange(s_need) < valid).astype(
+                    k_rows.dtype
+                )
+                k_rows = k_rows * live[None, None, :, None]
+                v_rows = v_rows * live[None, None, :, None]
             k_blocks = k_rows.reshape(L, hkv, mb, bs, dh).transpose(
                 0, 2, 1, 3, 4
             )
@@ -1851,8 +2246,20 @@ class PagedDecodeServer:
                 0, 2, 1, 3, 4
             )
             dest = jnp.where(jnp.arange(mb) >= skip, table_row, 0)
-            pk = pk.at[:, dest].set(k_blocks)
-            pv = pv.at[:, dest].set(v_blocks)
+            if isinstance(pk, dict):
+                kq, ks = _quantize_blocks(k_blocks)
+                vq, vs = _quantize_blocks(v_blocks)
+                pk = {
+                    "q": pk["q"].at[:, dest].set(kq),
+                    "s": pk["s"].at[:, dest].set(ks),
+                }
+                pv = {
+                    "q": pv["q"].at[:, dest].set(vq),
+                    "s": pv["s"].at[:, dest].set(vs),
+                }
+            else:
+                pk = pk.at[:, dest].set(k_blocks)
+                pv = pv.at[:, dest].set(v_blocks)
             return self._pool_constraint(pk, pv)
 
         return jax.jit(insert, donate_argnums=(0, 1))
@@ -1864,8 +2271,22 @@ class PagedDecodeServer:
         blocks to the flat suffix-prefill step. Reads the pool in
         place (no donation: the pool stays live)."""
         def gather(pk, pv, table_row):
-            kc = pk[:, table_row]  # [L, MB, Hkv, bs, Dh]
-            vc = pv[:, table_row]
+            if isinstance(pk, dict):
+                # Dequantize at the gather: the flat suffix-prefill
+                # step downstream only ever sees compute-dtype lanes.
+                kc = dequantize_symmetric(
+                    pk["q"][:, table_row],
+                    pk["s"][:, table_row][..., None, None],
+                    self.dec.compute_dtype,
+                )
+                vc = dequantize_symmetric(
+                    pv["q"][:, table_row],
+                    pv["s"][:, table_row][..., None, None],
+                    self.dec.compute_dtype,
+                )
+            else:
+                kc = pk[:, table_row]  # [L, MB, Hkv, bs, Dh]
+                vc = pv[:, table_row]
             L, mb, hkv, bs, dh = kc.shape
             kc = kc.transpose(0, 2, 1, 3, 4).reshape(
                 L, 1, hkv, mb * bs, dh
@@ -1953,6 +2374,124 @@ class PagedDecodeServer:
             rows_read = (hi - lo + 1) * bs
         self._account_kv_rows(rows_read, baseline)
 
+    def _spill_block(self, key: bytes, tok: bytes, blk: int) -> None:
+        """PrefixBlockCache on_evict hook (serving thread): dispatch
+        ASYNC device slices of the block being evicted and hand them
+        to the spill drain thread. The slices are fresh buffers cut
+        before any later donating dispatch can invalidate the pool;
+        the blocking device->host copy happens on the drain thread
+        (HostKVSpill._drain_loop), never here — eviction sits inside
+        the admission/tick hot path."""
+        b = blk  # python int: keepdim slice, no host round-trip
+        if isinstance(self.pool_k, dict):
+            arrays = (
+                self.pool_k["q"][:, b : b + 1],
+                self.pool_k["s"][:, b : b + 1],
+                self.pool_v["q"][:, b : b + 1],
+                self.pool_v["s"][:, b : b + 1],
+            )
+        else:
+            arrays = (
+                self.pool_k[:, b : b + 1],
+                self.pool_v[:, b : b + 1],
+            )
+        self._spill.offer(key, tok, arrays)
+
+    def _ensure_spill_up(self):
+        """One-block pool upload for spill revival: scatter a stored
+        block payload (int8 q + scales, or fp rows) back into block
+        `blk`. Memoized like every paged program; donates the pool."""
+        if self._spill_up is None:
+            from defer_tpu.utils.memo import cached_step
+
+            def build():
+                def up(pk, pv, *rest):
+                    if isinstance(pk, dict):
+                        kq, ks, vq, vs, blk = rest
+                        pk = {
+                            "q": pk["q"].at[:, blk].set(kq[:, 0]),
+                            "s": pk["s"].at[:, blk].set(ks[:, 0]),
+                        }
+                        pv = {
+                            "q": pv["q"].at[:, blk].set(vq[:, 0]),
+                            "s": pv["s"].at[:, blk].set(vs[:, 0]),
+                        }
+                    else:
+                        kb, vb, blk = rest
+                        pk = pk.at[:, blk].set(kb[:, 0])
+                        pv = pv.at[:, blk].set(vb[:, 0])
+                    return self._pool_constraint(pk, pv)
+
+                return jax.jit(up, donate_argnums=(0, 1))
+
+            self._spill_up = cached_step(
+                self.dec,
+                (
+                    "paged_spill_up", self.bs, self.kv_dtype,
+                    self._mesh_key,
+                ),
+                build,
+            )
+        return self._spill_up
+
+    def _revive_spilled(
+        self,
+        hits: list[int],
+        keys: list[bytes],
+        toks: list[bytes],
+        n_full: int,
+    ) -> list[int]:
+        """Extend a radix walk's leading hit run from the host spill
+        tier: for each miss position, look up the chain digest in the
+        spill store and, on a (token-byte-guarded) hit, re-upload the
+        EXACT stored payload into a newly allocated block and register
+        it. Raw-byte upload means a revived block is bit-identical to
+        the parked block it was spilled from — dequantizing and
+        re-quantizing instead could perturb values where round(x/s)
+        landed on a clip boundary — which is what makes a spill hit
+        token-identical to a resident hit. Stops at the first store
+        miss (chain order is mandatory: block j is meaningless without
+        0..j-1) or when the pool can't yield a block."""
+        j = len(hits)
+        while j < n_full:
+            got = self._spill.get(keys[j], toks[j])
+            if got is None:
+                break
+            if not self.free:
+                self.free.extend(self.radix.evict(1))
+                if not self.free:
+                    break
+            blk = self.free.pop()
+            up = self._ensure_spill_up()
+            if isinstance(self.pool_k, dict):
+                kq, ks, vq, vs = got
+                self.pool_k, self.pool_v = up(
+                    self.pool_k,
+                    self.pool_v,
+                    self._shard_ingest(kq),
+                    self._shard_ingest(ks),
+                    self._shard_ingest(vq),
+                    self._shard_ingest(vs),
+                    jnp.asarray(blk, jnp.int32),
+                )
+            else:
+                kb, vb = got
+                self.pool_k, self.pool_v = up(
+                    self.pool_k,
+                    self.pool_v,
+                    self._shard_ingest(kb),
+                    self._shard_ingest(vb),
+                    jnp.asarray(blk, jnp.int32),
+                )
+            displaced = self.radix.register(keys[j], toks[j], blk)
+            if displaced is not None:
+                self.free.append(displaced)
+            hits.append(blk)
+            self.spill_hits_n += 1
+            self.obs.prefix_spill_hits.inc()
+            j += 1
+        return hits
+
     def _admit_radix(
         self, i, rid, prompt, steps, adapter_id, samp, stop_seqs
     ) -> bool:
@@ -1969,6 +2508,8 @@ class PagedDecodeServer:
         n_full = t0 // bs
         total = -(-(t0 + steps) // bs)
         hits, keys, toks = self.radix.walk(tokens, n_full, bs)
+        if self._spill is not None and len(hits) < n_full:
+            hits = self._revive_spilled(hits, keys, toks, n_full)
         need = total - len(hits)
         if need > len(self.free):
             self.free.extend(
@@ -2048,6 +2589,7 @@ class PagedDecodeServer:
                 small["v"],
                 jnp.asarray(table_row),
                 jnp.asarray(len(hits), jnp.int32),
+                jnp.asarray(t0, jnp.int32),
             )
             logits_row = logits[:, ts - 1, :]
         for j in range(len(hits), n_full):
@@ -2110,7 +2652,10 @@ class PagedDecodeServer:
 
             self._insert_dyn = cached_step(
                 self.dec,
-                ("paged_insert_dyn", self.bs),
+                (
+                    "paged_insert_dyn", self.bs, self.kv_dtype,
+                    self._mesh_key,
+                ),
                 self._build_insert_dynamic,
             )
         return self._insert_dyn
@@ -2190,6 +2735,7 @@ class PagedDecodeServer:
             self._blocks_to_lane(v_blocks),
             jnp.asarray(table_row),
             jnp.asarray(len(hits), jnp.int32),
+            jnp.asarray(t0, jnp.int32),
         )
         if self.radix is not None:
             for j in range(len(hits), n_full):
@@ -2956,6 +3502,8 @@ def serve_paged(
     prefix_cache: bool = False,
     sampling: list | None = None,
     attention: str = "gathered",
+    kv_dtype: str = "fp",
+    spill_bytes: int = 0,
     decode_window: int = 1,
     spec_draft: Any = None,
     spec_params: dict | None = None,
@@ -2989,7 +3537,13 @@ def serve_paged(
     and the KV block pool shard over the named mesh axis and every
     tick body runs under shard_map (PagedDecodeServer docstring has
     the layout). Greedy output is token-identical to `mesh=None`;
-    stats then also carry `mesh_shape` and `tp_psums`."""
+    stats then also carry `mesh_shape` and `tp_psums`.
+
+    `kv_dtype="int8"` stores the pool quantized (PagedDecodeServer
+    docstring: half the HBM bytes, bounded-logit-error accuracy
+    contract); `spill_bytes=N` adds the host-RAM spill tier for
+    evicted prefix blocks (needs prefix_cache=True). Stats carry
+    `kv_dtype`, `pool_bytes` and the spill totals either way."""
     srv = PagedDecodeServer(
         dec,
         params,
@@ -3000,6 +3554,8 @@ def serve_paged(
         prefix_ids=prefix_ids,
         prefix_cache=prefix_cache,
         attention=attention,
+        kv_dtype=kv_dtype,
+        spill_bytes=spill_bytes,
         decode_window=decode_window,
         spec_draft=spec_draft,
         spec_params=spec_params,
@@ -3025,12 +3581,16 @@ def serve_paged(
         for (p, s), a, sp in zip(requests, aids, samps)
     ]
     done = srv.run()
+    if srv._spill is not None:
+        # Drain pending spill copies so the stats snapshot (and any
+        # caller inspecting the store) sees a settled tier.
+        srv._spill.flush()
     stats = ServerStats.snapshot(
         srv.obs.registry,
         ticks=srv.ticks,
         attention=attention,
         peak_blocks=srv.blocks_peak,
-        pool_blocks=int(srv.pool_k.shape[1]) - 1,
+        pool_blocks=srv.num_blocks - 1,
         block_size=block_size,
         flat_equivalent_rows=max_batch * dec.cfg.max_len,
         shared_prefix_blocks=len(srv.shared_blocks),
@@ -3055,5 +3615,14 @@ def serve_paged(
         prefill_chunk=srv.prefill_chunk,
         mesh_shape=srv.mesh_label,
         tp_psums=srv.tp_psums,
+        kv_dtype=srv.kv_dtype,
+        pool_bytes=srv.pool_bytes,
+        spilled_blocks=(
+            srv._spill.stored_blocks if srv._spill is not None else 0
+        ),
+        spill_hits=srv.spill_hits_n,
+        spill_stored_bytes=(
+            srv._spill.stored_bytes if srv._spill is not None else 0
+        ),
     )
     return [done[r] for r in rids], stats

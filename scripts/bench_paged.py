@@ -30,7 +30,12 @@ extras section. And `run_tp_sweep(devices) -> dict` (`--tp-sweep`) —
 the tensor-parallel serving sweep (model_axis in {1,2,4,8} on a
 {"model": m} mesh, runtime/paged.py `mesh=`) pricing tokens/sec,
 tokens-per-dispatch and per-shard KV rows read per axis size;
-bench.py runs it as the "tp_serving" extras section.
+bench.py runs it as the "tp_serving" extras section. And
+`run_kv_quant_sweep(devices) -> dict` (`--kv-quant-sweep`) — the
+KV-quantization sweep (kv_dtype fp vs int8 over the same
+over-subscribed Zipf prefix mix with the host-RAM spill tier on)
+pricing tokens/sec, resident-requests-per-pool-MiB and the spill
+revival rate; bench.py runs it as the "kv_quant" extras section.
 
 "pallas" is excluded by default off-TPU: the interpret-mode kernel is
 functionally identical but interpreter-slow, which would price the
@@ -531,6 +536,184 @@ def run_tp_sweep(
     return out
 
 
+def run_kv_quant_sweep(
+    devices=None,
+    *,
+    dtypes: tuple = ("fp", "int8"),
+    num_layers: int = 2,
+    dim: int = 64,
+    num_heads: int = 4,
+    num_kv_heads: int = 2,
+    vocab_size: int = 512,
+    max_len: int = 256,
+    num_blocks: int = 17,
+    block_size: int = 4,
+    max_batch: int = 2,
+    num_requests: int = 12,
+    num_prefixes: int = 4,
+    prefix_len: int = 16,
+    spill_bytes: int = 32 << 20,
+) -> dict:
+    """KV-quantization sweep: the same over-subscribed Zipf-prefix
+    request mix served with a fp pool vs an int8+scales pool, both with
+    the host-RAM spill tier on. Returns {config, dtypes: {d:
+    {tokens_per_sec, pool_bytes, pool_bytes_vs_fp,
+    resident_requests_per_pool_mib, spilled_blocks, spill_hits,
+    spill_revival_rate, prefill_tokens, prefill_tokens_no_spill,
+    prefill_tokens_saved}}}.
+
+    The request mix is Zipf-ish over `num_prefixes` shared prefixes
+    (popularity ~ 1/rank), dealt round-robin so a popular prefix's next
+    request arrives only after the other prefixes' traffic has pushed
+    its cached blocks out of the deliberately undersized pool — the
+    over-subscription that makes eviction (and hence spill) happen at
+    all. Three things are being priced: (1) capacity — int8 stores the
+    same blocks in itemsize-fold fewer bytes (4x under fp32 compute,
+    2x under this sweep's bf16, plus per-[layer,block,head] scales),
+    so resident-requests-per-pool-MiB is the headline ratio; (2) the
+    spill tier — spilled_blocks / spill_hits under pressure, with
+    prefill_tokens vs the spill_bytes=0 baseline showing the prefill
+    rows the revivals saved; (3) throughput — tokens/sec, which off-TPU
+    mostly prices dispatch overhead (the HBM-bandwidth win needs real
+    hardware; the obs row counters are dtype-agnostic by design).
+
+    spill_revival_rate is spill_hits / spilled_blocks — the fraction of
+    evicted-and-spilled blocks a later request actually revived (> 0 is
+    the acceptance bar; ~1 means the spill store is doing real work)."""
+    import jax
+    import jax.numpy as jnp
+
+    from defer_tpu import obs
+    from defer_tpu.models.gpt import GptDecoder
+    from defer_tpu.models.llama import llama_config
+    from defer_tpu.runtime.paged import serve_paged
+
+    cfg = llama_config(
+        num_layers=num_layers,
+        dim=dim,
+        num_heads=num_heads,
+        num_kv_heads=num_kv_heads,
+        ffn_dim=dim * 2,
+        vocab_size=vocab_size,
+        max_len=max_len,
+    )
+    dec = GptDecoder(cfg, compute_dtype=jnp.bfloat16)
+    params = dec.cast_params(dec.init(jax.random.key(0)))
+    if devices:
+        params = jax.device_put(params, devices[0])
+
+    # Zipf-ish popularity: prefix r gets ~1/(r+1) of the traffic.
+    weights = [1.0 / (r + 1) for r in range(num_prefixes)]
+    wsum = sum(weights)
+    counts = [
+        max(1, round(num_requests * w / wsum)) for w in weights
+    ]
+    while sum(counts) > num_requests:
+        counts[counts.index(max(counts))] -= 1
+    while sum(counts) < num_requests:
+        counts[0] += 1
+    prefixes = [
+        jax.random.randint(
+            jax.random.fold_in(jax.random.key(7), r),
+            (1, prefix_len),
+            0,
+            cfg.vocab_size,
+        )
+        for r in range(num_prefixes)
+    ]
+    # Deal round-robin: a prefix's next request lands only after the
+    # other prefixes' traffic had a chance to evict its blocks.
+    order = []
+    for j in range(max(counts)):
+        for r in range(num_prefixes):
+            if counts[r] > j:
+                order.append(r)
+    reqs = []
+    for i, r in enumerate(order):
+        tail = 2 + (i * 3) % 4
+        steps = 12 + (i * 7) % 12
+        suffix = jax.random.randint(
+            jax.random.fold_in(jax.random.key(11), i),
+            (1, tail),
+            0,
+            cfg.vocab_size,
+        )
+        reqs.append((jnp.concatenate([prefixes[r], suffix], axis=1), steps))
+    total_tokens = sum(s for _, s in reqs)
+    # Mean per-request footprint in blocks, for the capacity metric.
+    blocks_per_req = sum(
+        -(-(p.shape[1] + s) // block_size) for p, s in reqs
+    ) / len(reqs)
+    out: dict = {
+        "config": {
+            "num_layers": num_layers,
+            "dim": dim,
+            "heads": f"{num_heads}/{num_kv_heads}kv",
+            "max_len": max_len,
+            "num_blocks": num_blocks,
+            "block_size": block_size,
+            "max_batch": max_batch,
+            "requests": num_requests,
+            "total_tokens": total_tokens,
+            "prefix_mix": f"zipf({num_prefixes})x{prefix_len}tok",
+            "spill_bytes": spill_bytes,
+        },
+        "dtypes": {},
+    }
+    lab = 'server="paged"'
+    fp_pool_bytes = None
+    for d in dtypes:
+
+        def run(spill):
+            t0 = time.perf_counter()
+            with obs.counter_deltas() as deltas:
+                outs, stats = serve_paged(
+                    dec,
+                    params,
+                    reqs,
+                    num_blocks=num_blocks,
+                    block_size=block_size,
+                    max_batch=max_batch,
+                    prefix_cache=True,
+                    kv_dtype=d,
+                    spill_bytes=spill,
+                )
+                jax.block_until_ready(outs[-1])
+            return time.perf_counter() - t0, deltas, stats
+
+        run(spill_bytes)  # compile pass
+        dt, deltas, stats = run(spill_bytes)
+        _, base_deltas, _ = run(0)  # no-spill baseline: same mix
+        if fp_pool_bytes is None:
+            fp_pool_bytes = stats["pool_bytes"]
+        prefill = deltas.get(f"defer_prefill_tokens_total{{{lab}}}", 0)
+        prefill_base = base_deltas.get(
+            f"defer_prefill_tokens_total{{{lab}}}", 0
+        )
+        spilled = deltas.get(f"defer_prefix_spilled_total{{{lab}}}", 0)
+        out["dtypes"][d] = {
+            "tokens_per_sec": round(total_tokens / dt, 1),
+            "pool_bytes": stats["pool_bytes"],
+            "pool_bytes_vs_fp": round(
+                stats["pool_bytes"] / fp_pool_bytes, 4
+            ),
+            "resident_requests_per_pool_mib": round(
+                ((num_blocks - 1) / blocks_per_req)
+                / (stats["pool_bytes"] / (1 << 20)),
+                2,
+            ),
+            "spilled_blocks": spilled,
+            "spill_hits": stats["spill_hits"],
+            "spill_revival_rate": round(
+                stats["spill_hits"] / max(1, spilled), 4
+            ),
+            "prefill_tokens": prefill,
+            "prefill_tokens_no_spill": prefill_base,
+            "prefill_tokens_saved": stats["prefill_tokens_saved"],
+        }
+    return out
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(
         description="paged-decode attention microbench (one JSON line)"
@@ -575,6 +758,18 @@ def main() -> None:
         "(0 = non-speculative baseline)",
     )
     ap.add_argument(
+        "--kv-quant-sweep",
+        action="store_true",
+        help="run the KV-quantization sweep (kv_dtype = --kv-dtypes, "
+        "over-subscribed Zipf prefix mix with the spill tier on) "
+        "instead of the attention microbench",
+    )
+    ap.add_argument(
+        "--kv-dtypes",
+        default="fp,int8",
+        help="comma-separated kv_dtype values for --kv-quant-sweep",
+    )
+    ap.add_argument(
         "--tp-sweep",
         action="store_true",
         help="run the tensor-parallel serving sweep (model_axis = "
@@ -599,7 +794,30 @@ def main() -> None:
         max_batch=args.batch,
         num_requests=args.requests,
     )
-    if args.tp_sweep:
+    if args.kv_quant_sweep:
+        # Same default-dropping as --spec-sweep: the sweep's own model
+        # and (deliberately undersized) pool defaults win unless a
+        # flag was explicitly overridden.
+        arg_of = {
+            "num_layers": "layers",
+            "dim": "dim",
+            "num_heads": "heads",
+            "num_kv_heads": "kv_heads",
+            "vocab_size": "vocab",
+            "max_len": "max_len",
+            "num_blocks": "blocks",
+            "block_size": "block_size",
+            "max_batch": "batch",
+            "num_requests": "requests",
+        }
+        shared = {
+            k: v
+            for k, v in shared.items()
+            if v != ap.get_default(arg_of[k])
+        }
+        dtypes = tuple(d for d in args.kv_dtypes.split(",") if d)
+        rec = run_kv_quant_sweep(dtypes=dtypes, **shared)
+    elif args.tp_sweep:
         # Same default-dropping as --spec-sweep: run_tp_sweep's own
         # model defaults (kv_heads=8 so every axis divides) win unless
         # a flag was explicitly overridden.

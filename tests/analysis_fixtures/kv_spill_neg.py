@@ -1,0 +1,26 @@
+"""NEGATIVE: the drain-thread spill the paged server ships
+(runtime/paged.py::HostKVSpill) — the tick only ENQUEUES device
+slices (async dispatch, no transfer), and the blocking host copy
+happens on the spill tier's own thread, off the serving hot set."""
+
+import numpy as np
+
+
+class Server:
+    def _tick(self):
+        logits, self.pool = self._step(self.pool)
+        if self._pressure():
+            blk = self._evict_one()
+            # Async handoff: device slices go into a bounded queue;
+            # nothing here waits on the copy.
+            self._spill.offer(self._key(blk), self._tok(blk), self.pool)
+
+
+class HostKVSpill:
+    def _drain_loop(self):
+        # Spill tier's own thread: the blocking device->host transfer
+        # is the drain thread's whole job, not the tick's.
+        while True:
+            key, tok, arrays = self._q.get()
+            self._store[key] = tuple(np.asarray(a) for a in arrays)
+            self._q.task_done()

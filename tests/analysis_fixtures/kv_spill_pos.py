@@ -1,0 +1,21 @@
+"""POSITIVE: spill-tier copies issued synchronously inside the
+serving tick — evicting a prefix block by blocking on the
+device->host transfer stalls every seated request behind one block's
+DMA (the exact stall the drain-thread design exists to avoid)."""
+
+import numpy as np
+
+
+class Server:
+    def _tick(self):
+        logits, self.pool = self._step(self.pool)
+        if self._pressure():
+            blk = self._evict_one()
+            # Synchronous spill copy ON the tick path: the transfer
+            # completes before the next decode step can dispatch.
+            self._store[blk] = np.asarray(self.pool[:, blk])
+        self._spill_scale(blk)
+
+    def _spill_scale(self, blk):
+        # Reachable from _tick: one more blocking pull per eviction.
+        self._scales[blk] = self.scale[blk].item()
