@@ -566,10 +566,13 @@ def bench_decode_window(devices) -> dict:
 
 def bench_speculative(devices) -> dict:
     """Paged speculative decoding (scripts/bench_paged.py): the same
-    request mix served at spec_k in {0,2,4} with a self-draft
-    (acceptance 1.0), pricing tokens/sec and dispatches-per-token per
-    k. Isolates the dispatch-amortization term — each two-dispatch
-    round commits up to k+1 tokens per slot."""
+    request mix served at spec_k in {0,2,4} crossed with the draft
+    axis (self | trunc:L/2 | trunc:L/4 | width:1/2, built with
+    models/transplant.py make_draft), pricing MEASURED acceptance,
+    tokens/sec and dispatches-per-token per (draft, k) — the
+    acceptance-vs-speedup frontier. The self-draft column isolates
+    the dispatch-amortization term (acceptance 1.0); the truncated/
+    pruned columns price what a real small draft pays."""
     import importlib.util
     import os
 

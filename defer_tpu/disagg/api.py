@@ -130,6 +130,9 @@ def serve_disagg(
     attention: str = "gathered",
     kv_dtype: str = "fp",
     decode_window: int = 1,
+    spec_k: int = 0,
+    spec_draft: Any = None,
+    spec_params: dict | None = None,
     sampling: list | None = None,
     stop: list | None = None,
     quantize: str | None = None,
@@ -156,7 +159,15 @@ def serve_disagg(
     jitted scatter requantizes the decoded wire blocks on landing, so
     a Q8 transfer (`quantize="int8"`) feeding an int8 pool never holds
     a widened copy beyond the ingest staging buffer — the wire format
-    itself is unchanged."""
+    itself is unchanged.
+
+    `spec_k>0` (with `spec_draft`/`spec_params`) speculates on the
+    decode side: the worker ships TARGET K/V only, and each prefilled
+    admission re-prefills the draft lane locally from the prompt ids
+    (PagedDecodeServer._admit_prefilled) — the draft's prefill is the
+    cheap side of the compute asymmetry the disagg split exists for,
+    so recompute beats shipping a second KV stream. Greedy outputs
+    stay token-identical to the non-speculative split."""
     srv = server
     if srv is None:
         srv = PagedDecodeServer(
@@ -170,6 +181,9 @@ def serve_disagg(
             attention=attention,
             kv_dtype=kv_dtype,
             decode_window=decode_window,
+            spec_k=spec_k,
+            spec_draft=spec_draft,
+            spec_params=spec_params,
         )
     samps = sampling or [None] * len(requests)
     stops = stop or [None] * len(requests)
@@ -298,6 +312,16 @@ def serve_disagg(
         prefill_tokens_saved=srv.prefill_tokens_saved,
         kv_dtype=srv.kv_dtype,
         pool_bytes=srv.pool_bytes,
+        spec_k=srv.spec_k,
+        spec_rounds=srv.spec_rounds_n,
+        spec_proposed=srv.spec_proposed_n,
+        spec_accepted=srv.spec_accepted_n,
+        spec_acceptance=(
+            srv.spec_accepted_n / srv.spec_proposed_n
+            if srv.spec_proposed_n
+            else 0.0
+        ),
+        spec_draft_tokens=srv.spec_draft_tokens_n,
         disagg=True,
         quantize=quantize,
         kv_bytes_recv=recv.rx_frame_bytes,

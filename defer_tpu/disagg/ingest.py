@@ -24,6 +24,12 @@ never needs to know the pool dtype. The host-RAM spill tier
 split in the other direction: its drain thread does device->host
 copies only, while pool revival stays on the serving thread.
 
+Speculative decode (`spec_k>0` on the decode server) rides the same
+path untouched: the wire carries TARGET K/V only, and the serving
+thread's `_admit_prefilled` seeds the DRAFT lane by re-prefilling it
+locally from the prompt ids after the delivered blocks seat — the
+ingest layer never sees draft state.
+
 Failure protocol (the retry seam `disagg/api.py` drives): a transport
 death flips `failed` and parks the drain thread; the orchestrator
 drops the dead peer (`receiver.next_peer()`), respawns a worker,

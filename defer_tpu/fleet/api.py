@@ -82,6 +82,9 @@ class FleetFrontend:
         kv_dtype: str = "fp",
         spill_bytes: int = 0,
         decode_window: int = 1,
+        spec_k: int = 0,
+        spec_draft: Any = None,
+        spec_params: dict | None = None,
         policy: str = "prefix",
         slo_s: float | None = None,
         max_queue: int = 0,
@@ -155,6 +158,9 @@ class FleetFrontend:
                 kv_dtype=kv_dtype,
                 spill_bytes=spill_bytes,
                 decode_window=decode_window,
+                spec_k=spec_k,
+                spec_draft=spec_draft,
+                spec_params=spec_params,
                 **_placement(i),
             )
 
@@ -335,6 +341,16 @@ class FleetFrontend:
                     mesh_shape=srv.mesh_label,
                     kv_dtype=srv.kv_dtype,
                     pool_bytes=srv.pool_bytes,
+                    spec_k=srv.spec_k,
+                    spec_rounds=srv.spec_rounds_n,
+                    spec_proposed=srv.spec_proposed_n,
+                    spec_accepted=srv.spec_accepted_n,
+                    spec_acceptance=(
+                        srv.spec_accepted_n / srv.spec_proposed_n
+                        if srv.spec_proposed_n
+                        else 0.0
+                    ),
+                    spec_draft_tokens=srv.spec_draft_tokens_n,
                     spilled_blocks=(
                         srv._spill.stored_blocks
                         if srv._spill is not None
@@ -370,6 +386,9 @@ def serve_fleet(
     kv_dtype: str = "fp",
     spill_bytes: int = 0,
     decode_window: int = 1,
+    spec_k: int = 0,
+    spec_draft: Any = None,
+    spec_params: dict | None = None,
     sampling: list | None = None,
     stop: list | None = None,
     policy: str = "prefix",
@@ -399,7 +418,17 @@ def serve_fleet(
     (PagedDecodeServer docstring). Prefix-block migration between
     replicas is dtype-transparent: export dequantizes to the wire's
     compute dtype and the importing replica's pool requantizes on
-    landing, so mixed-pool fleets still migrate."""
+    landing, so mixed-pool fleets still migrate.
+
+    `spec_k`/`spec_draft`/`spec_params` turn on speculative decoding
+    on EVERY replica (each gets its own DraftLanes over its own
+    devices). Migration composes for free: only TARGET prefix blocks
+    ship between pools, and the admitting replica's draft lane always
+    re-prefills the full prompt locally (radix hits are a pool
+    concept the draft does not share), so a migrated admission
+    speculates exactly like a local one. A dying replica's draft
+    lanes are torn down with its pool (`DraftLanes.release_all` in
+    the replica loop's failure path)."""
     fe = FleetFrontend(
         dec,
         params,
@@ -413,6 +442,9 @@ def serve_fleet(
         kv_dtype=kv_dtype,
         spill_bytes=spill_bytes,
         decode_window=decode_window,
+        spec_k=spec_k,
+        spec_draft=spec_draft,
+        spec_params=spec_params,
         policy=policy,
         slo_s=slo_s,
         max_queue=max_queue,

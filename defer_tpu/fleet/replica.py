@@ -251,6 +251,12 @@ class ThreadReplica:
             for gid in self._gid_of.values():
                 self.on_fail(gid, ReplicaDeadError(self.idx, e))
             self._gid_of.clear()
+            if srv._draft is not None:
+                # Draft lanes hold per-slot K/V for the dead requests;
+                # clear them with the pool so a post-mortem reader (or
+                # a spawner that recycles the server object) never
+                # sees stale draft state for requests that failed.
+                srv._draft.release_all()
             self.obs.inflight[self.idx].set(0)
             self.obs.pool_free[self.idx].set(0)
             self.on_dead(self.idx, e)

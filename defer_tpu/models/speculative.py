@@ -285,6 +285,9 @@ def speculative_generate(
         obs.spec_proposed.inc(k)
         if a:
             obs.spec_accepted.inc(a)
+        # Per-round accepted-length observation (defer_spec_acceptance
+        # is a histogram; its mean is acceptance * k).
+        obs.spec_acceptance.observe(a)
 
         if a == k:
             # Bonus token (Leviathan/Chen): the verify forward's final
@@ -332,8 +335,6 @@ def speculative_generate(
 
     ids = ids[:, : t0 + num_steps]
     acceptance = accepted_total / max(1, rounds * k)
-    if rounds:
-        obs.spec_acceptance.set(acceptance)
     stats = {
         "target_steps": target_steps,
         "plain_steps": num_steps,

@@ -29,6 +29,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Callable, Mapping, Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -423,3 +424,165 @@ def load_keras_h5(
             if arrays:
                 out[lname] = arrays
     return out
+
+
+# --------------------------------------------------------------------------
+# Draft construction: shrink a GPT target into a speculation draft
+# --------------------------------------------------------------------------
+
+# Per-axis slice spec for each stacked-block parameter (after the
+# leading layer axis): "d" = model width, "f" = FFN width, "kv" = the
+# KV projection width (kv_heads * Dh — NEVER sliced: the draft must
+# keep the target's kv_heads so its proposals come from the same
+# attention geometry the verifier scores).
+_DRAFT_STACK_AXES: dict[str, tuple[str, ...]] = {
+    "wq": ("d", "d"),
+    "wk": ("d", "kv"),
+    "wv": ("d", "kv"),
+    "wo": ("d", "d"),
+    "w1": ("d", "f"),
+    "w2": ("f", "d"),
+    "w3": ("d", "f"),
+    "ln1_scale": ("d",),
+    "ln2_scale": ("d",),
+    "ln1_bias": ("d",),
+    "ln2_bias": ("d",),
+    "bq": ("d",),
+    "bk": ("kv",),
+    "bv": ("kv",),
+    "bo": ("d",),
+    "b1": ("f",),
+    "b2": ("d",),
+}
+
+
+def draft_width_geometry(cfg, width: float) -> tuple[int, int, int]:
+    """(num_heads', dim', ffn_dim') for a width-pruned draft of `cfg`.
+
+    Head count rounds to the nearest multiple of kv_heads (floor 1x)
+    so GQA grouping survives the prune; Dh is untouched, so rope
+    frequencies and per-head shapes stay target-identical and dim'
+    follows the head count. FFN width scales freely (floor 1)."""
+    if not (0.0 < width <= 1.0):
+        raise TransplantError(
+            f"width={width}: draft width fraction must be in (0, 1]"
+        )
+    kv = cfg.kv_heads
+    dh = cfg.dim // cfg.num_heads
+    heads = kv * max(1, round(cfg.num_heads * width / kv))
+    heads = min(heads, cfg.num_heads)
+    ffn = max(1, round(cfg.ffn_dim * width))
+    return heads, heads * dh, ffn
+
+
+def make_draft(
+    decoder,
+    params: Mapping[str, Any],
+    *,
+    layers: int | None = None,
+    width: float | None = None,
+    dtype: Any = None,
+):
+    """Carve a small speculation draft out of a GPT target.
+
+    Returns `(draft_decoder, draft_params)` where the draft is the
+    target with the first `layers` blocks kept (layer truncation)
+    and/or its query heads + FFN pruned to a `width` fraction
+    (head/FFN slicing with the matching projection rows/columns
+    re-stitched so the sliced tree is a valid transformer). Vocab,
+    kv_heads, head dim, positions (learned table or rope base) and
+    max_len are preserved — exactly the geometry `DraftLanes`
+    validates against the target at server construction.
+
+    `dtype="int8"` additionally routes the sliced tree through
+    `models/quant.py::quantize_decoder_params` (weight-only symmetric
+    int8 — the draft's HBM reads halve again); any other dtype casts
+    float leaves (like `GptDecoder.cast_params`). The draft is an
+    APPROXIMATION of the target — acceptance < 1 is the point; the
+    verify forward keeps outputs token-identical regardless.
+    """
+    from defer_tpu.models.gpt import GptDecoder
+    from defer_tpu.models.quant import quantize_decoder_params
+
+    cfg = getattr(decoder, "cfg", None)
+    if cfg is None or "stack" not in params:
+        raise TransplantError(
+            "make_draft needs a GptDecoder-style (decoder, params) pair "
+            "(a .cfg config and a params['stack'] block tree)"
+        )
+    if any(
+        isinstance(v, dict) and "q" in v
+        for v in list(params["stack"].values())
+        + [params.get("token_embedding")]
+        if v is not None
+    ):
+        raise TransplantError(
+            "make_draft slices float params: quantized {'q','s'} leaves "
+            "would lose their per-channel scales — build the draft from "
+            "the float tree, then ask for dtype='int8'"
+        )
+    L = cfg.num_layers
+    keep_l = L if layers is None else layers
+    if not (1 <= keep_l <= L):
+        raise TransplantError(
+            f"layers={layers}: draft must keep between 1 and "
+            f"{L} (the target's depth) blocks"
+        )
+    if width is None:
+        heads, dim, ffn = cfg.num_heads, cfg.dim, cfg.ffn_dim
+    else:
+        heads, dim, ffn = draft_width_geometry(cfg, width)
+    dims = {"d": dim, "f": ffn, "kv": cfg.kv_heads * (cfg.dim // cfg.num_heads)}
+
+    def cut(leaf, axes):
+        idx = (slice(0, keep_l),) + tuple(
+            slice(0, dims[a]) for a in axes
+        )
+        return jnp.asarray(leaf)[idx]
+
+    stack = {}
+    for k, v in params["stack"].items():
+        if k not in _DRAFT_STACK_AXES:
+            raise TransplantError(
+                f"stack param {k!r} has no draft slice rule — drafts "
+                "support plain GPT/llama decoder stacks (no MoE, no "
+                "LoRA adapters; merge adapters first)"
+            )
+        stack[k] = cut(v, _DRAFT_STACK_AXES[k])
+    out: dict[str, Any] = {"stack": stack}
+    out["token_embedding"] = jnp.asarray(params["token_embedding"])[:, :dim]
+    out["final_ln_scale"] = jnp.asarray(params["final_ln_scale"])[:dim]
+    if "final_ln_bias" in params:
+        out["final_ln_bias"] = jnp.asarray(params["final_ln_bias"])[:dim]
+    if "pos_embedding" in params:
+        out["pos_embedding"] = jnp.asarray(params["pos_embedding"])[:, :dim]
+    if "lm_head" in params:
+        out["lm_head"] = jnp.asarray(params["lm_head"])[:dim, :]
+
+    dcfg = dataclasses.replace(
+        cfg,
+        num_layers=keep_l,
+        num_heads=heads,
+        num_kv_heads=cfg.kv_heads,
+        dim=dim,
+        ffn_dim=ffn,
+    )
+    draft = GptDecoder(dcfg, compute_dtype=decoder.compute_dtype)
+    if dtype == "int8":
+        out = quantize_decoder_params(out)
+    elif dtype is not None:
+        out = {
+            k: jax.tree_util.tree_map(
+                lambda a: a.astype(dtype)
+                if jnp.issubdtype(a.dtype, jnp.floating)
+                else a,
+                v,
+            )
+            for k, v in out.items()
+        }
+    log.info(
+        "draft: %d/%d layers, %d/%d heads, dim %d/%d, ffn %d/%d%s",
+        keep_l, L, heads, cfg.num_heads, dim, cfg.dim, ffn, cfg.ffn_dim,
+        " (int8)" if dtype == "int8" else "",
+    )
+    return draft, out

@@ -210,9 +210,23 @@ class ServingMetrics:
             "defer_spec_rounds_total",
             "Speculative propose/verify rounds executed", labels,
         )
-        self.spec_acceptance = reg.gauge(
+        self.spec_draft_tokens = reg.counter(
+            "defer_spec_draft_tokens_total",
+            "Tokens the DRAFT model computed forwards for (catch-up "
+            "feeds + proposal scan steps) — the speculation overhead "
+            "side of the acceptance-vs-speedup frontier", labels,
+        )
+        # Per-round accepted-length distribution: one observation per
+        # greedy slot per round, value = draft tokens accepted in
+        # [0, k]. Integer-edge buckets make `le="a"` read "rounds that
+        # accepted <= a proposals"; the running mean (sum/count) is
+        # the old gauge's acceptance*k. Edges cover k <= 16; larger k
+        # folds into +Inf, still mean-exact.
+        self.spec_acceptance = reg.histogram(
             "defer_spec_acceptance",
-            "Running fraction of proposed draft tokens accepted",
+            "Accepted draft tokens per speculative round per slot "
+            "(distribution; mean = acceptance * spec_k)",
+            tuple(float(b) for b in range(17)),
             labels,
         )
 

@@ -187,7 +187,14 @@ class DraftLanes:
     1 — the duplicate row is written at pos+1 and immediately
     overwritten by the first scan step."""
 
-    def __init__(self, dec: Any, params: dict, max_batch: int):
+    def __init__(
+        self,
+        dec: Any,
+        params: dict,
+        max_batch: int,
+        *,
+        target: Any = None,
+    ):
         if getattr(dec, "rolling_cache", False):
             raise ValueError(
                 "a rolling-cache draft cannot rewind rejected rows"
@@ -199,6 +206,8 @@ class DraftLanes:
                 "does not"
             )
         dec.decode_step_fn()  # SpmdGptDecoder raises at construction
+        if target is not None:
+            self._check_geometry(dec.cfg, target.cfg)
         self.dec = dec
         self.params = params
         self.B = max_batch
@@ -229,54 +238,120 @@ class DraftLanes:
         )
         self.pos[i] = t0
 
+    @staticmethod
+    def _check_geometry(draft_cfg, target_cfg) -> None:
+        """Draft-vs-target geometry gates, each with the fix spelled
+        out. The draft proposes TOKEN IDS the target scores, so the
+        vocabularies must be the same id space; kv_heads and the
+        position encoding must match so a transplant-carved draft
+        (models/transplant.py::make_draft) is attending with the same
+        per-head/rotary geometry the verifier will re-score under —
+        anything else silently tanks acceptance."""
+        if draft_cfg.vocab_size != target_cfg.vocab_size:
+            raise ValueError(
+                f"draft vocab_size={draft_cfg.vocab_size} != target "
+                f"vocab_size={target_cfg.vocab_size}: proposals are "
+                "target-vocab token ids. Fix: build the draft from the "
+                "target with models/transplant.py::make_draft (it "
+                "preserves the vocabulary), or retrain the draft on "
+                "the target's tokenizer."
+            )
+        if draft_cfg.kv_heads != target_cfg.kv_heads:
+            raise ValueError(
+                f"draft kv_heads={draft_cfg.kv_heads} != target "
+                f"kv_heads={target_cfg.kv_heads}. Fix: carve the draft "
+                "with make_draft(width=...) — it prunes QUERY heads to "
+                "a multiple of the target's kv_heads and never touches "
+                "the KV width — instead of hand-shrinking num_kv_heads."
+            )
+        if draft_cfg.pos_style != target_cfg.pos_style:
+            raise ValueError(
+                f"draft pos_style={draft_cfg.pos_style!r} != target "
+                f"pos_style={target_cfg.pos_style!r}: the two models "
+                "would disagree about every position. Fix: make_draft "
+                "keeps the target's position encoding; use it."
+            )
+        if (
+            draft_cfg.pos_style == "rope"
+            and draft_cfg.rope_theta != target_cfg.rope_theta
+        ):
+            raise ValueError(
+                f"draft rope_theta={draft_cfg.rope_theta} != target "
+                f"rope_theta={target_cfg.rope_theta}: rotary frequency "
+                "bases must match or long-context proposals rotate "
+                "away from the verifier. Fix: make_draft preserves "
+                "rope_theta (and the head dim it applies to); rebuild "
+                "the draft with it."
+            )
+
     def release(self, i: int) -> None:
+        """Clear lane i COMPLETELY: pos back to 0 AND the cached K/V
+        rows zeroed. pos alone is not enough — an idle lane still
+        rides through every propose dispatch (masked by posm=0), and
+        stale rows from a slot retired MID-ROUND would otherwise sit
+        in device memory until the next admit overwrites them."""
         self.pos[i] = 0
+        self.ck = self.ck.at[:, i].set(0)
+        self.cv = self.cv.at[:, i].set(0)
+
+    def release_all(self) -> None:
+        """Drop every lane — the replica-death / server-teardown path
+        (fleet/replica.py): no slot survives, so no lane may either."""
+        self.pos[:] = 0
+        self.ck = jnp.zeros_like(self.ck)
+        self.cv = jnp.zeros_like(self.cv)
+
+    def _propose_body(self, k: int):
+        """The RAW (unjitted) propose body `(params, dk, dv, dpos,
+        feed2, adv) -> (dk, dv, props)` — trace-compatible with
+        `lax.scan`, so the paged server can fuse W draft+verify rounds
+        into ONE `decode_window` program (runtime/paged.py::
+        _tick_spec_window) instead of dispatching propose W times."""
+        raw = self.dec.decode_step_fn()
+
+        def propose(params, dk, dv, dpos, feed2, adv):
+            cache = {"k": dk, "v": dv, "pos": dpos}
+            logits2, cache = raw(params, cache, feed2)
+            # Row adv-1 is the prediction after the LAST real
+            # pending token; later rows are duplicate-feed noise.
+            first_l = jnp.take_along_axis(
+                logits2,
+                jnp.maximum(adv - 1, 0)[:, None, None],
+                axis=1,
+            )[:, 0, :]
+            nxt = jnp.argmax(first_l, axis=-1).astype(jnp.int32)
+            # Correct per-slot positions after the variable-lag
+            # catch-up (the raw step advanced every row by 2).
+            pos1 = dpos + adv
+
+            def body(carry, _):
+                ck, cv, pos, tok = carry
+                lg, c2 = raw(
+                    params,
+                    {"k": ck, "v": cv, "pos": pos},
+                    tok[:, None],
+                )
+                t2 = jnp.argmax(lg[:, -1, :], axis=-1).astype(
+                    jnp.int32
+                )
+                return (c2["k"], c2["v"], c2["pos"], t2), t2
+
+            (dk, dv, _, _), rest = lax.scan(
+                body,
+                (cache["k"], cache["v"], pos1, nxt),
+                None,
+                length=k - 1,
+            )
+            props = jnp.concatenate([nxt[:, None], rest.T], axis=1)
+            return dk, dv, props
+
+        return propose
 
     def _build_propose(self, k: int):
-        dec = self.dec
-
         def build():
-            raw = dec.decode_step_fn()
+            return jax.jit(self._propose_body(k), donate_argnums=(1, 2))
 
-            def propose(params, dk, dv, dpos, feed2, adv):
-                cache = {"k": dk, "v": dv, "pos": dpos}
-                logits2, cache = raw(params, cache, feed2)
-                # Row adv-1 is the prediction after the LAST real
-                # pending token; later rows are duplicate-feed noise.
-                first_l = jnp.take_along_axis(
-                    logits2,
-                    jnp.maximum(adv - 1, 0)[:, None, None],
-                    axis=1,
-                )[:, 0, :]
-                nxt = jnp.argmax(first_l, axis=-1).astype(jnp.int32)
-                # Correct per-slot positions after the variable-lag
-                # catch-up (the raw step advanced every row by 2).
-                pos1 = dpos + adv
-
-                def body(carry, _):
-                    ck, cv, pos, tok = carry
-                    lg, c2 = raw(
-                        params,
-                        {"k": ck, "v": cv, "pos": pos},
-                        tok[:, None],
-                    )
-                    t2 = jnp.argmax(lg[:, -1, :], axis=-1).astype(
-                        jnp.int32
-                    )
-                    return (c2["k"], c2["v"], c2["pos"], t2), t2
-
-                (dk, dv, _, _), rest = lax.scan(
-                    body,
-                    (cache["k"], cache["v"], pos1, nxt),
-                    None,
-                    length=k - 1,
-                )
-                props = jnp.concatenate([nxt[:, None], rest.T], axis=1)
-                return dk, dv, props
-
-            return jax.jit(propose, donate_argnums=(1, 2))
-
-        return cached_step(dec, ("spec_propose", self.B, k), build)
+        return cached_step(self.dec, ("spec_propose", self.B, k), build)
 
     def propose(self, k, posm, feed2, adv):
         """One fused draft dispatch: catch up on pending committed

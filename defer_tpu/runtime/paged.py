@@ -638,12 +638,16 @@ class PagedDecodeServer:
         output is bit-identical to spec_k=0; sampled slots ride the
         verify forward's first row and advance one token per round
         from the SAME key stream as spec_k=0. The default 0 keeps the
-        classic tick loop untouched. Composes with prefix_cache and
-        mixed sampling; raises with decode_window > 1 (both amortize
-        host dispatches — fuse one way or the other), constructor
-        prefix_ids (the draft lane has no shared-prefix plumbing),
-        multi-LoRA (the draft is one model), and submit_prefilled
-        (the draft never saw the prompt).
+        classic tick loop untouched. Composes with prefix_cache,
+        mixed sampling, decode_window > 1 (the window scan's sub-steps
+        become whole draft+verify rounds — W rounds per host
+        dispatch), submit_prefilled admissions (the draft lane
+        re-prefills locally from the prompt ids), and tensor-parallel
+        meshes (the draft is replicated; only the verify forward is
+        sharded). Still raises with constructor prefix_ids (the draft
+        lane has no shared-prefix plumbing) and multi-LoRA (the draft
+        is one model — per-adapter proposals would need per-adapter
+        drafts).
 
         `prefill_chunk` — chunked POOL-NATIVE prefill: admission runs
         the prompt through the multi-token paged step in chunks of
@@ -771,12 +775,6 @@ class PagedDecodeServer:
                 raise ValueError(
                     "spec_k > 0 needs both spec_draft and spec_params "
                     "(the proposal model and its weights)"
-                )
-            if decode_window > 1:
-                raise ValueError(
-                    "spec_k > 0 and decode_window > 1 both fuse "
-                    "multiple tokens into one host dispatch — compose "
-                    "is unsupported, pick one"
                 )
             if prefix_ids is not None:
                 raise ValueError(
@@ -1020,7 +1018,7 @@ class PagedDecodeServer:
         # Draft lanes (runtime/decode_server.py::DraftLanes): the
         # draft model's flat per-slot K/V plus host position truth.
         self._draft = (
-            DraftLanes(spec_draft, spec_params, max_batch)
+            DraftLanes(spec_draft, spec_params, max_batch, target=dec)
             if spec_k
             else None
         )
@@ -1029,6 +1027,7 @@ class PagedDecodeServer:
         self.spec_rounds_n = 0
         self.spec_proposed_n = 0
         self.spec_accepted_n = 0
+        self.spec_draft_tokens_n = 0
         self.prefix_len = 0
         self.shared_blocks: list[int] = []
         self._prefix_cache = None
@@ -1218,7 +1217,11 @@ class PagedDecodeServer:
         from a base-model worker would silently skew LoRA requests.
         (`prefix_cache=True` composes fine — ingested full prompt
         blocks register in the radix cache like locally prefilled
-        ones.)"""
+        ones. `spec_k>0` composes too: the TARGET K/V arrives over
+        the wire, and admission re-prefills the DRAFT lane locally
+        from the prompt ids — draft prefill is the cheap side of the
+        asymmetry, so decode-worker speculation keeps the disagg
+        split's point.)"""
         if self.shared_blocks or self.prefix_len:
             raise ValueError(
                 "externally prefilled admission does not compose with "
@@ -1229,12 +1232,6 @@ class PagedDecodeServer:
                 "externally prefilled admission supports the base "
                 "model only (adapter-specific K/V would need the "
                 "worker to run the same adapter banks)"
-            )
-        if self.spec_k:
-            raise ValueError(
-                "externally prefilled admission does not compose with "
-                "speculative decoding: the draft never prefilled this "
-                "prompt, so it has no K/V to propose from"
             )
         prompt = np.asarray(prompt_ids)
         if prompt.ndim != 2 or prompt.shape[0] != 1:
@@ -1247,10 +1244,15 @@ class PagedDecodeServer:
         t0 = prompt.shape[1]
         if t0 < 1 or num_steps < 1:
             raise ValueError("need at least 1 prompt token and 1 step")
-        if t0 + num_steps > self.dec.cfg.max_len:
+        # Same spec_k write headroom as submit(): verify forwards
+        # write candidate rows past the committed position.
+        if t0 + num_steps + self.spec_k > self.dec.cfg.max_len:
+            extra = (
+                f" + spec_k {self.spec_k} headroom" if self.spec_k else ""
+            )
             raise ValueError(
-                f"prompt {t0} + steps {num_steps} exceeds max_len "
-                f"{self.dec.cfg.max_len}"
+                f"prompt {t0} + steps {num_steps}{extra} exceeds "
+                f"max_len {self.dec.cfg.max_len}"
             )
         need = self._own_need(t0, num_steps)
         usable = self.num_blocks - 1
@@ -2113,6 +2115,209 @@ class PagedDecodeServer:
             build,
         )
 
+    def _build_spec_window(self, mode: str):
+        """The fused spec x decode_window program: W = decode_window
+        draft+verify rounds in ONE jitted dispatch. Each scan sub-step
+        is a whole speculative round — the DraftLanes propose body
+        (decode_server.py::_propose_body) followed by the multi-token
+        verify forward (_mt_body) — plus the on-device mirror of the
+        host accept test (first proposal/argmax mismatch, then the
+        bonus row), eos/budget freezing exactly like _build_window
+        (frozen rows pin position 0 and trash-redirect their writes),
+        and the pend/lane-position recurrence _tick_spec runs on the
+        host between rounds. Greedy rows therefore emit the TARGET's
+        own chain token for token; sampled rows draw one token per
+        round from the verify forward's row 0 through the same
+        batched-sampler trio the plain window uses — streams identical
+        to decode_window=1 speculation by construction.
+
+        Per window the host gets ONE batched sync: the [W, B, k+1]
+        token buffer plus the small per-round kept/accept vectors that
+        drive drain bookkeeping — W rounds (up to W*(k+1) tokens per
+        slot) amortize it, vs 2 dispatches + 1 sync per round
+        unfused."""
+        from defer_tpu.utils.memo import cached_step
+
+        k = self.spec_k
+        W = self.decode_window
+        eos = self.eos_id
+        draft = self._draft
+
+        def build():
+            propose_raw = draft._propose_body(k)
+            mt_raw = self._mt_body()
+
+            def window(params, pk, pv, dk, dv, dparams, tables, pos,
+                       dpos, feed, feed2, adv, active, sampling_row,
+                       keys, temp, topk, topp, minp, budget,
+                       adapter_ids):
+                B = pos.shape[0]
+                steps = jnp.arange(k + 1)
+                zero_from = jnp.zeros_like(pos)
+
+                def body(carry, _):
+                    (pk, pv, dk, dv, pos, dpos, feed, feed2, adv,
+                     active, keys, n) = carry
+                    greedy = active & ~sampling_row
+                    # Draft propose: idle/sampled/frozen lanes pin to
+                    # position 0 with adv 0, the idle-lane idiom.
+                    dpos_eff = jnp.where(greedy, dpos, 0)
+                    adv_eff = jnp.where(greedy, adv, 0)
+                    dk, dv, props = propose_raw(
+                        dparams, dk, dv, dpos_eff, feed2, adv_eff
+                    )
+                    # Verify all k+1 candidates; frozen rows write
+                    # trash (n_keep 0, position 0, all-trash table).
+                    verify_in = jnp.concatenate(
+                        [feed, props.astype(jnp.int32)], axis=1
+                    )
+                    n_keep = jnp.where(
+                        active,
+                        jnp.where(sampling_row, 1, k + 1),
+                        0,
+                    ).astype(jnp.int32)
+                    pos_eff = jnp.where(active, pos, 0)
+                    tab_eff = jnp.where(active[:, None], tables, 0)
+                    logits, pk, pv = mt_raw(
+                        params, pk, pv, tab_eff, pos_eff, verify_in,
+                        n_keep, zero_from, adapter_ids,
+                    )
+                    preds = jnp.argmax(logits, axis=-1).astype(
+                        jnp.int32
+                    )
+                    # On-device accept test — the batching.py
+                    # accept_lengths rule: first props/preds mismatch,
+                    # k on full agreement.
+                    mismatch = props != preds[:, :k]
+                    a = jnp.where(
+                        mismatch.any(axis=1),
+                        jnp.argmax(mismatch, axis=1),
+                        k,
+                    ).astype(jnp.int32)
+                    bonus = jnp.take_along_axis(
+                        preds, a[:, None], axis=1
+                    )[:, 0]
+                    props_pad = jnp.concatenate(
+                        [props, jnp.zeros((B, 1), jnp.int32)], axis=1
+                    )
+                    toks = jnp.where(
+                        steps[None, :] < a[:, None],
+                        props_pad,
+                        bonus[:, None],
+                    )
+                    # Sampled rows: one draw per round from row 0 —
+                    # the same key/policy stream as the plain paths.
+                    ll = logits[:, 0, :]
+                    if mode == "argmax":
+                        nxt = jnp.argmax(ll, axis=-1).astype(jnp.int32)
+                    elif mode == "nosort":
+                        nxt, keys = sample_token_batched_nosort(
+                            ll, keys, temp, minp
+                        )
+                    else:
+                        nxt, keys = sample_token_batched(
+                            ll, keys, temp, topk, topp, minp
+                        )
+                    nxt = nxt.astype(jnp.int32)
+                    toks = jnp.where(
+                        sampling_row[:, None], nxt[:, None], toks
+                    )
+                    cand = jnp.where(sampling_row, 1, a + 1)
+                    cand = jnp.where(active, cand, 0)
+                    kept = jnp.minimum(
+                        cand, jnp.maximum(budget - n, 0)
+                    )
+                    alive = active
+                    if eos is not None:
+                        hit = (toks == eos) & (
+                            steps[None, :] < kept[:, None]
+                        )
+                        any_eos = hit.any(axis=1)
+                        kept = jnp.where(
+                            any_eos,
+                            jnp.argmax(hit, axis=1) + 1,
+                            kept,
+                        )
+                        alive = alive & ~any_eos
+                    n = n + kept
+                    alive = alive & (n < budget)
+                    last = jnp.take_along_axis(
+                        toks, jnp.maximum(kept - 1, 0)[:, None], axis=1
+                    )[:, 0]
+                    feed = jnp.where(
+                        (kept > 0)[:, None], last[:, None], feed
+                    )
+                    pos = pos + kept
+                    # Continuing greedy rows: partial accept leaves
+                    # only the correction token pending (adv 1), full
+                    # accept also the never-consumed k-th proposal
+                    # (adv 2) — _tick_spec's host recurrence, on
+                    # device. Truncated rows froze above, so the
+                    # update mask never sees a cut round.
+                    full = a == k
+                    adv_next = jnp.where(full, 2, 1).astype(jnp.int32)
+                    f2a = jnp.where(full, props_pad[:, k - 1], last)
+                    upd = alive & ~sampling_row
+                    adv = jnp.where(upd, adv_next, adv)
+                    feed2 = jnp.where(
+                        upd[:, None],
+                        jnp.stack([f2a, last], axis=1),
+                        feed2,
+                    )
+                    dpos = jnp.where(upd, pos + 1 - adv_next, dpos)
+                    out = (toks, kept, a, greedy, adv_eff)
+                    return (
+                        (pk, pv, dk, dv, pos, dpos, feed, feed2, adv,
+                         alive, keys, n),
+                        out,
+                    )
+
+                init = (
+                    pk, pv, dk, dv, pos, dpos, feed, feed2, adv,
+                    active, keys, jnp.zeros_like(budget),
+                )
+                (
+                    (pk, pv, dk, dv, pos, dpos, feed, feed2, adv,
+                     alive, keys, n),
+                    (toks_a, kept_a, a_a, greedy_a, advu_a),
+                ) = lax.scan(body, init, None, length=W)
+                return (
+                    pk, pv, dk, dv, feed, feed2, adv, alive, keys,
+                    toks_a, kept_a, a_a, greedy_a, advu_a,
+                )
+
+            if self.mesh is None:
+                return jax.jit(window, donate_argnums=(1, 2, 3, 4))
+            # Sharded spec window: ONE shard_map around the whole
+            # W-round scan. The target verify runs sharded exactly as
+            # _ensure_mt's body does; the DRAFT is replicated state —
+            # its params, lanes and propose math ride as replicated
+            # operands and every shard computes identical proposals
+            # (no collectives in the draft forward), so the accept
+            # test and sampler advance identically per shard.
+            from jax.sharding import PartitionSpec as PSpec
+
+            from defer_tpu.utils.compat import shard_map
+
+            pool, r = self._pool_specs, PSpec()
+            sm = shard_map(
+                window,
+                self.mesh,
+                in_specs=(self._sdec._specs(), pool, pool)
+                + (r,) * 18,
+                out_specs=(pool, pool) + (r,) * 12,
+                check_rep=False,
+            )
+            return jax.jit(sm, donate_argnums=(1, 2, 3, 4))
+
+        return cached_step(
+            self.dec,
+            ("paged_spec_window", self.bs, self.attention,
+             self.kv_dtype, W, k, mode, eos, draft.dec.cfg,
+             str(draft.dec.compute_dtype), self._mesh_key),
+            build,
+        )
+
     def _pool_constraint(self, *arrays):
         """Pin pool-layout (or flat-lane) outputs of the plain-jit
         data-movement programs (insert / gather / import) to the
@@ -2773,6 +2978,13 @@ class PagedDecodeServer:
         if shared is not None:
             slot["shared"] = shared
         self.slots[i] = slot
+        if self._draft is not None and samp is None:
+            # The delivered KV covers only the TARGET; the draft lane
+            # re-prefills locally from the prompt ids (the draft never
+            # saw this prompt on the prefill worker, and shipping its
+            # tiny K/V would cost more coordination than recompute).
+            slot["pend"] = [int(first[0, 0])]
+            self._draft.admit(i, jnp.asarray(prompt))
         self._feed = self._feed.at[i].set(first[0].astype(jnp.int32))
         self.obs.ttft.observe(
             time.perf_counter()
@@ -2948,6 +3160,8 @@ class PagedDecodeServer:
 
     def _tick(self) -> None:
         if self.spec_k:
+            if self.decode_window > 1:
+                return self._tick_spec_window()
             return self._tick_spec()
         if self.decode_window > 1:
             return self._tick_window()
@@ -3174,6 +3388,7 @@ class PagedDecodeServer:
         a_vec = accept_lengths(props_host, preds_host[:, :k])
         proposed = 0
         accepted_draft = 0
+        draft_toks = 0
         accepted = [0] * self.B
         finishing = [False] * self.B
         toks_host: list[list[int] | None] = [None] * self.B
@@ -3189,6 +3404,10 @@ class PagedDecodeServer:
                 a = int(a_vec[i])
                 proposed += k
                 accepted_draft += a
+                # analysis: ignore[host-sync-in-hot-loop] adv is the
+                # host round-0 seed (np.zeros filled from slot pend)
+                draft_toks += int(adv[i]) + k - 1
+                self.obs.spec_acceptance.observe(a)
                 emitted = [int(t) for t in props_host[i, :a]]
                 emitted.append(int(preds_host[i, a]))
             # Per-token drain, K=1-equivalent: budget, then eos, then
@@ -3246,15 +3465,14 @@ class PagedDecodeServer:
         self.spec_rounds_n += 1
         self.spec_proposed_n += proposed
         self.spec_accepted_n += accepted_draft
+        self.spec_draft_tokens_n += draft_toks
         self.obs.spec_rounds.inc()
         if proposed:
             self.obs.spec_proposed.inc(proposed)
         if accepted_draft:
             self.obs.spec_accepted.inc(accepted_draft)
-        if self.spec_proposed_n:
-            self.obs.spec_acceptance.set(
-                self.spec_accepted_n / self.spec_proposed_n
-            )
+        if draft_toks:
+            self.obs.spec_draft_tokens.inc(draft_toks)
         # Mean per-dispatch yield: a round is two dispatches.
         self.obs.tokens_per_dispatch.set(float(sum(accepted)) / 2.0)
         if self.on_token is not None:
@@ -3265,6 +3483,262 @@ class PagedDecodeServer:
                     toks_host[i][t],
                     finishing[i] and t == accepted[i] - 1,
                 )
+        for i in range(self.B):
+            if finishing[i]:
+                self._finish(i)
+
+    def _tick_spec_window(self) -> None:
+        """W = decode_window speculative rounds in ONE host dispatch
+        (_build_spec_window): the draft propose + target verify +
+        accept test + pend recurrence all live inside the fused scan,
+        so a window costs 1 dispatch and 1 batched sync where the
+        unfused path costs 2W dispatches and W syncs. Greedy output
+        is token-identical to spec_k=0 (and to decode_window=1
+        speculation); stop sequences cut on drain with overshoot
+        discarded, the _tick_window contract."""
+        live = [s is not None for s in self.slots]
+        if not any(live):
+            return
+        self._build()
+        k, W = self.spec_k, self.decode_window
+        sampling_rows = [
+            s is not None and s["sampling"] for s in self.slots
+        ]
+        if not any(sampling_rows):
+            mode = "argmax"
+        elif any(self._sampler.row_sort):
+            mode = "sort"
+        else:
+            mode = "nosort"
+        prog = self._build_spec_window(mode)
+        # Round-0 seeds from host truth, exactly _tick_spec's: pend =
+        # committed-but-unconsumed draft tokens, lane write head
+        # pos + 1 - len(pend).
+        feed2 = np.zeros((self.B, 2), np.int32)
+        adv = np.zeros((self.B,), np.int32)
+        dposm = np.zeros((self.B,), np.int32)
+        for i, slot in enumerate(self.slots):
+            if slot is None or slot["sampling"]:
+                continue
+            pend = slot["pend"]
+            adv[i] = len(pend)
+            feed2[i, 0] = pend[0]
+            feed2[i, 1] = pend[-1]
+            dposm[i] = self.pos[i] + 1 - len(pend)
+        budget = [
+            s["remaining"] if s is not None else 0
+            for s in self.slots
+        ]
+        posm = np.where(live, self.pos, 0).astype(np.int32)
+        sm = self._sampler
+        # Same aliasing-copy rule as every tick: tables/adapter are
+        # host-mutated by finish/admission while the dispatched window
+        # may still be reading them.
+        (self.pool_k, self.pool_v, dk, dv, feed, feed2_o, adv_o,
+         alive, keys, toks_a, kept_a, a_a, greedy_a, advu_a) = prog(
+            self.params, self.pool_k, self.pool_v,
+            self._draft.ck, self._draft.cv, self._draft.params,
+            jnp.asarray(self.tables.copy()), jnp.asarray(posm),
+            jnp.asarray(dposm), self._feed, jnp.asarray(feed2),
+            jnp.asarray(adv), jnp.asarray(live),
+            jnp.asarray(sampling_rows), sm.keys, sm.temp, sm.topk,
+            sm.topp, sm.minp, jnp.asarray(budget, jnp.int32),
+            jnp.asarray(self.adapter.copy()),
+        )
+        self._draft.ck, self._draft.cv = dk, dv
+        self._feed = feed
+        sm.keys = keys
+        self.ticks += 1
+        self.dispatches += 1
+        n_live = sum(live)
+        now = time.perf_counter()
+        if self._last_tick_t is not None:
+            self.obs.itl.observe(now - self._last_tick_t, n_live)
+        self._last_tick_t = now
+        self.obs.ticks.inc()
+        self.obs.host_dispatches.inc()
+        # W verify forwards' worth of collectives per dispatch (the
+        # draft forward is replicated, no psums — _tick_spec's rule).
+        self._account_psums(W)
+        # The ONE batched sync per window: the [W, B, k+1] token
+        # buffer plus the per-round kept/accept vectors — every piece
+        # of drain bookkeeping reads these host copies.
+        # analysis: ignore[host-sync-in-hot-loop] the ONE batched
+        # [W, B, k+1] token transfer per fused spec window — up to
+        # W*(k+1) tokens per slot amortize it (spec_window fixtures
+        # pin the shape)
+        toks_h = np.asarray(toks_a)
+        # analysis: ignore[host-sync-in-hot-loop] per-round kept
+        # counts, same per-window sync point (ready with the tokens)
+        kept_h = np.asarray(kept_a)
+        # analysis: ignore[host-sync-in-hot-loop] per-round accept
+        # lengths, same batched per-window sync point
+        a_h = np.asarray(a_a)
+        # analysis: ignore[host-sync-in-hot-loop] per-round proposer
+        # masks, same batched sync point
+        greedy_h = np.asarray(greedy_a)
+        # analysis: ignore[host-sync-in-hot-loop] per-round draft
+        # catch-up counts, same batched sync point
+        advu_h = np.asarray(advu_a)
+        # analysis: ignore[host-sync-in-hot-loop] final liveness, same
+        # batched sync point
+        alive_h = np.asarray(alive)
+        # analysis: ignore[host-sync-in-hot-loop] pend recurrence feed
+        # pair, same batched sync point
+        feed2_h = np.asarray(feed2_o)
+        # analysis: ignore[host-sync-in-hot-loop] pend recurrence
+        # advance, same batched sync point
+        adv_h = np.asarray(adv_o)
+        # Verify-read accounting: the per-round mirror of _tick_spec's
+        # (active rows read to pos_r + k; frozen rows sit at trash
+        # position 0). Pure host python over the fetched counts.
+        baseline = W * self.B * self.MB * self.bs
+        if self.attention == "gathered":
+            rows_read = baseline
+        else:
+            win = self.dec.cfg.window
+            pos_l = posm.tolist()
+            rows_read = 0
+            for r in range(W):
+                pe = [
+                    p if kept_h[r][i] > 0 else 0
+                    for i, p in enumerate(pos_l)
+                ]
+                if self.attention == "blockwise":
+                    rows_read += (
+                        self.B
+                        * ((max(pe) + k) // self.bs + 1)
+                        * self.bs
+                    )
+                else:  # pallas
+                    rows_read += self.bs * sum(
+                        (p + k) // self.bs
+                        - (max(p - win + 1, 0) // self.bs
+                           if win is not None else 0)
+                        + 1
+                        for p in pe
+                    )
+                pos_l = [
+                    p + int(kept_h[r][i])
+                    for i, p in enumerate(pos_l)
+                ]
+        self._account_kv_rows(rows_read, baseline)
+        # Drain: per slot, walk the rounds in order; stop sequences
+        # cut on the host (push_window per round) and discard the
+        # overshoot the device kept generating — the _tick_window
+        # contract. eos/budget freezes already happened on device.
+        proposed = 0
+        accepted_draft = 0
+        draft_toks = 0
+        rounds_run = 0
+        kept_rounds: list[list[int]] = [[0] * self.B for _ in range(W)]
+        total = [0] * self.B
+        finishing = [False] * self.B
+        stream_toks: list[list[list[int]] | None] = [None] * self.B
+        for r in range(W):
+            ran = False
+            for i, slot in enumerate(self.slots):
+                if slot is None:
+                    continue
+                if greedy_h[r][i]:
+                    ran = True
+                    proposed += k
+                    a_r = int(a_h[r][i])
+                    accepted_draft += a_r
+                    draft_toks += int(advu_h[r][i]) + k - 1
+                    self.obs.spec_acceptance.observe(a_r)
+                n_r = int(kept_h[r][i])
+                if n_r == 0:
+                    continue
+                row = [int(t) for t in toks_h[r][i][:n_r]]
+                if finishing[i]:
+                    row = []  # overshoot past a stop cut
+                elif slot["stop"] is not None:
+                    hit = slot["stop"].push_window(row)
+                    if hit is not None:
+                        row = row[:hit]
+                        finishing[i] = True
+                        self.obs.window_truncated.inc()
+                kept_rounds[r][i] = len(row)
+                total[i] += len(row)
+                if stream_toks[i] is None:
+                    stream_toks[i] = [[] for _ in range(W)]
+                stream_toks[i][r] = row
+            if ran:
+                rounds_run += 1
+        for i, slot in enumerate(self.slots):
+            if slot is None:
+                continue
+            n_i = total[i]
+            slot["remaining"] -= n_i
+            if finishing[i] or not alive_h[i]:
+                slot["remaining"] = 0
+            # analysis: ignore[host-sync-in-hot-loop] packs already-
+            # fetched host token lists (no device fetch)
+            kept_arr = np.asarray(
+                [
+                    t
+                    for r in range(W)
+                    for t in (stream_toks[i][r]
+                              if stream_toks[i] else [])
+                ],
+                np.int32,
+            )[None, :]
+            # analysis: ignore[host-sync-in-hot-loop] uploads the
+            # kept host tokens (no fetch), _tick_spec's idiom
+            tok_block = jnp.asarray(kept_arr).astype(
+                slot["last"].dtype
+            )
+            if n_i:
+                slot["toks"].append(tok_block)
+                slot["last"] = tok_block[:, -1:]
+            self.pos[i] += n_i
+            finishing[i] = slot["remaining"] == 0
+            self.obs.tokens_generated.inc(n_i)
+            self.window_tokens += n_i
+            if not slot["sampling"] and not finishing[i]:
+                # Continuing greedy rows: reconstruct pend from the
+                # device recurrence's final (feed2, adv) — host truth
+                # for the next window's round-0 seed.
+                av = int(adv_h[i])
+                slot["pend"] = [
+                    int(t) for t in feed2_h[i][2 - av:]
+                ]
+                self._draft.pos[i] = (
+                    self.pos[i] + 1 - len(slot["pend"])
+                )
+        self.spec_rounds_n += rounds_run
+        self.spec_proposed_n += proposed
+        self.spec_accepted_n += accepted_draft
+        self.spec_draft_tokens_n += draft_toks
+        self.obs.spec_rounds.inc(rounds_run)
+        if proposed:
+            self.obs.spec_proposed.inc(proposed)
+        if accepted_draft:
+            self.obs.spec_accepted.inc(accepted_draft)
+        if draft_toks:
+            self.obs.spec_draft_tokens.inc(draft_toks)
+        self.obs.tokens_per_dispatch.set(float(sum(total)))
+        if self.on_token is not None:
+            last_r = [
+                max(
+                    (r for r in range(W) if kept_rounds[r][i]),
+                    default=0,
+                )
+                for i in range(self.B)
+            ]
+            for r in range(W):
+                for t, i in window_drain_order(
+                    kept_rounds[r], k + 1
+                ):
+                    slot = self.slots[i]
+                    self.on_token(
+                        slot["rid"],
+                        stream_toks[i][r][t],
+                        finishing[i]
+                        and r == last_r[i]
+                        and t == kept_rounds[r][i] - 1,
+                    )
         for i in range(self.B):
             if finishing[i]:
                 self._finish(i)
@@ -3530,8 +4004,8 @@ def serve_paged(
     speculative decoding (PagedDecodeServer docstring): greedy
     outputs stay token-identical to `spec_k=0`; stats then also carry
     `spec_rounds` / `spec_proposed` / `spec_accepted` /
-    `spec_acceptance`. `prefill_chunk=C` switches admission to the
-    pool-native chunked prefill path.
+    `spec_acceptance` / `spec_draft_tokens`. `prefill_chunk=C`
+    switches admission to the pool-native chunked prefill path.
 
     `mesh=` / `model_axis=` run the server tensor-parallel: weights
     and the KV block pool shard over the named mesh axis and every
@@ -3612,6 +4086,7 @@ def serve_paged(
             if srv.spec_proposed_n
             else 0.0
         ),
+        spec_draft_tokens=srv.spec_draft_tokens_n,
         prefill_chunk=srv.prefill_chunk,
         mesh_shape=srv.mesh_label,
         tp_psums=srv.tp_psums,

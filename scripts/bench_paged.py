@@ -23,10 +23,12 @@ the CLI) — the fused-decode-window sweep (decode_window = K in
 {1,4,8,16}) pricing host dispatches per token against tokens/sec;
 bench.py runs it as the "decode_window" extras section. And
 `run_spec_sweep(devices) -> dict` (`--spec-sweep`) — the paged
-speculative-decoding sweep (spec_k in {0,2,4}, self-draft so
-acceptance is 1.0) pricing tokens/sec, acceptance and
-dispatches-per-token per k; bench.py runs it as the "speculative"
-extras section. And `run_tp_sweep(devices) -> dict` (`--tp-sweep`) —
+speculative-decoding sweep (spec_k in {0,2,4} crossed with a DRAFT
+AXIS: self | trunc:L/2 | trunc:L/4 | width:1/2, built with
+models/transplant.py `make_draft`) pricing MEASURED acceptance,
+tokens/sec and dispatches-per-token per (draft, k) — the
+acceptance-vs-speedup frontier; bench.py runs it as the
+"speculative" extras section. And `run_tp_sweep(devices) -> dict` (`--tp-sweep`) —
 the tensor-parallel serving sweep (model_axis in {1,2,4,8} on a
 {"model": m} mesh, runtime/paged.py `mesh=`) pricing tokens/sec,
 tokens-per-dispatch and per-shard KV rows read per axis size;
@@ -289,7 +291,8 @@ def run_spec_sweep(
     devices=None,
     *,
     ks: tuple = (0, 2, 4),
-    num_layers: int = 2,
+    drafts: tuple = ("self", "trunc:L/2", "trunc:L/4", "width:1/2"),
+    num_layers: int = 4,
     dim: int = 64,
     num_heads: int = 4,
     num_kv_heads: int = 2,
@@ -299,35 +302,53 @@ def run_spec_sweep(
     block_size: int = 16,
     max_batch: int = 4,
     num_requests: int = 8,
+    decode_window: int = 1,
+    late_scale: float = 0.25,
 ) -> dict:
-    """Paged speculative-decoding sweep: the same fixed request mix
-    served at spec_k = k for each k (0 = the classic tick loop, the
-    baseline). Returns {config, ks: {k: {tokens_per_sec, acceptance,
-    spec_rounds, host_dispatches, dispatches_per_token,
-    speedup_vs_k0}}}.
+    """Paged speculative-decoding sweep over a DRAFT AXIS: the same
+    fixed request mix served at spec_k = k for each k and each draft
+    construction (0 = the classic tick loop, the shared baseline).
+    Returns {config, baseline, drafts: {label: {geometry, ks: {k:
+    {tokens_per_sec, acceptance, spec_rounds, host_dispatches,
+    dispatches_per_token, draft_tokens, speedup_vs_k0}}}}, ks} where
+    the top-level `ks` keeps the old self-draft table shape
+    (baseline row at 0) for existing readers.
 
-    The draft IS the target (self-draft): every proposal matches the
-    target's own argmax, acceptance sits at 1.0, and each two-dispatch
-    round commits k+1 tokens per slot — the sweep isolates the
-    DISPATCH-AMORTIZATION term of speculation (what k buys when the
-    draft is perfect), which is exactly the term that shows up off-TPU
-    where per-dispatch overhead dominates small-model decode. A real
-    deployment's draft is smaller and pays acceptance < 1; the
-    `acceptance` field is reported so the same sweep prices that too
-    (swap the draft in the caller).
+    The draft axis is the acceptance-vs-speedup frontier: `self`
+    (draft IS the target — acceptance 1.0, isolating the pure
+    dispatch-amortization term), `trunc:L/2` / `trunc:L/4`
+    (layer-truncated via models/transplant.py `make_draft(layers=)` —
+    the residual stream after the shared prefix layers still
+    correlates with the full forward, so acceptance lands BETWEEN 0
+    and 1 and the sweep measures a real frontier point), and
+    `width:1/2` (head/FFN-pruned via `make_draft(width=)`). Each
+    draft's `acceptance` is MEASURED, not assumed; speculation wins
+    exactly where `(1 + acceptance*k) / 2 > 1` dispatch-for-dispatch
+    and the draft's forward is cheap enough to not eat the margin.
 
-    Defaults are deliberately SMALLER than the other sweeps': a
-    self-draft doubles model compute per token, so speculation only
-    pays where per-dispatch overhead dominates compute — the regime
-    small drafts / big targets occupy on real hardware, emulated here
-    by shrinking the model rather than the draft (random tiny drafts
-    have ~0 acceptance against an unrelated target, which would
-    measure nothing)."""
+    `decode_window=W>1` prices the fused spec x window path: W whole
+    draft+verify rounds per host dispatch (dispatches_per_token drops
+    by ~W on top of the round amortization).
+
+    `late_scale` shrinks the residual WRITE (wo/w2 + biases) of the
+    late half of the target's stack after init. Trained checkpoints
+    concentrate most of the logit-relevant residual mass in early
+    layers — that is the property layer truncation banks on — but
+    random init spreads it uniformly, which would price every real
+    draft at acceptance ~ 0 and measure nothing. The shrink restores
+    the trained-model shape; acceptance is still MEASURED, never
+    assumed (set late_scale=1.0 to see the uniform-init floor).
+
+    Defaults are deliberately SMALLER than the other sweeps':
+    speculation only pays where per-dispatch overhead dominates
+    compute — the regime small drafts / big targets occupy on real
+    hardware, emulated here by shrinking the model."""
     import jax
     import jax.numpy as jnp
 
     from defer_tpu.models.gpt import GptDecoder
     from defer_tpu.models.llama import llama_config
+    from defer_tpu.models.transplant import make_draft
     from defer_tpu.runtime.paged import serve_paged
 
     cfg = llama_config(
@@ -340,7 +361,20 @@ def run_spec_sweep(
         max_len=max_len,
     )
     dec = GptDecoder(cfg, compute_dtype=jnp.bfloat16)
-    params = dec.cast_params(dec.init(jax.random.key(0)))
+    params = dec.init(jax.random.key(0))
+    if late_scale != 1.0 and num_layers > 1:
+        half = num_layers // 2
+        ramp = jnp.asarray(
+            [1.0 if l < half else late_scale for l in range(num_layers)]
+        )
+        st = dict(params["stack"])
+        for key in ("wo", "w2"):
+            st[key] = st[key] * ramp[:, None, None]
+        for key in ("bo", "b2"):
+            if key in st:
+                st[key] = st[key] * ramp[:, None]
+        params = {**params, "stack": st}
+    params = dec.cast_params(params)
     if devices:
         params = jax.device_put(params, devices[0])
     reqs = []
@@ -355,6 +389,43 @@ def run_spec_sweep(
         )
         reqs.append((prompt, steps))
     total_tokens = sum(s for _, s in reqs)
+
+    def build_draft(label):
+        """label -> (draft decoder, draft params). `trunc:L/n` slices
+        the first num_layers//n layers; `width:p/q` prunes heads+FFN
+        to the fraction p/q; `self` reuses the target."""
+        if label == "self":
+            return dec, params
+        kind, _, arg = label.partition(":")
+        if kind == "trunc":
+            den = int(arg.split("/")[1])
+            return make_draft(
+                dec, params, layers=max(1, num_layers // den)
+            )
+        if kind == "width":
+            num, den = arg.split("/")
+            return make_draft(dec, params, width=float(num) / float(den))
+        raise ValueError(f"unknown draft axis label {label!r}")
+
+    def timed(**kwargs):
+        def run():
+            t0 = time.perf_counter()
+            outs, stats = serve_paged(
+                dec,
+                params,
+                reqs,
+                num_blocks=num_blocks,
+                block_size=block_size,
+                max_batch=max_batch,
+                decode_window=decode_window,
+                **kwargs,
+            )
+            jax.block_until_ready(outs[-1])
+            return time.perf_counter() - t0, stats
+
+        run()  # compile pass
+        return run()
+
     out: dict = {
         "config": {
             "num_layers": num_layers,
@@ -366,47 +437,58 @@ def run_spec_sweep(
             "max_batch": max_batch,
             "requests": num_requests,
             "total_tokens": total_tokens,
-            "draft": "self",
+            "decode_window": decode_window,
+            "drafts": list(drafts),
         },
-        "ks": {},
+        "drafts": {},
     }
-    base_tps = None
-    for k in ks:
-        spec = (
-            dict(spec_draft=dec, spec_params=params, spec_k=k)
-            if k
-            else {}
-        )
-
-        def run():
-            t0 = time.perf_counter()
-            outs, stats = serve_paged(
-                dec,
-                params,
-                reqs,
-                num_blocks=num_blocks,
-                block_size=block_size,
-                max_batch=max_batch,
-                **spec,
-            )
-            jax.block_until_ready(outs[-1])
-            return time.perf_counter() - t0, stats
-
-        run()  # compile pass
-        dt, stats = run()
-        tps = total_tokens / dt
-        if base_tps is None:
-            base_tps = tps
-        out["ks"][k] = {
-            "tokens_per_sec": round(tps, 1),
-            "acceptance": round(stats["spec_acceptance"], 4),
-            "spec_rounds": stats["spec_rounds"],
-            "host_dispatches": stats["host_dispatches"],
-            "dispatches_per_token": round(
-                stats["host_dispatches"] / total_tokens, 4
+    dt, stats = timed()
+    base_tps = total_tokens / dt
+    baseline = {
+        "tokens_per_sec": round(base_tps, 1),
+        "acceptance": 0.0,
+        "spec_rounds": 0,
+        "host_dispatches": stats["host_dispatches"],
+        "dispatches_per_token": round(
+            stats["host_dispatches"] / total_tokens, 4
+        ),
+        "draft_tokens": 0,
+        "speedup_vs_k0": 1.0,
+    }
+    out["baseline"] = baseline
+    for label in drafts:
+        draft, dparams = build_draft(label)
+        dcfg = draft.cfg
+        per: dict = {
+            "geometry": (
+                f"{dcfg.num_layers}L/{dcfg.num_heads}h/"
+                f"{dcfg.dim}d/{dcfg.ffn_dim}f"
             ),
-            "speedup_vs_k0": round(tps / base_tps, 3),
+            "ks": {},
         }
+        for k in ks:
+            if not k:
+                continue
+            dt, stats = timed(
+                spec_draft=draft, spec_params=dparams, spec_k=k
+            )
+            tps = total_tokens / dt
+            per["ks"][k] = {
+                "tokens_per_sec": round(tps, 1),
+                "acceptance": round(stats["spec_acceptance"], 4),
+                "spec_rounds": stats["spec_rounds"],
+                "host_dispatches": stats["host_dispatches"],
+                "dispatches_per_token": round(
+                    stats["host_dispatches"] / total_tokens, 4
+                ),
+                "draft_tokens": stats["spec_draft_tokens"],
+                "speedup_vs_k0": round(tps / base_tps, 3),
+            }
+        out["drafts"][label] = per
+    # Old table shape (self-draft, baseline at k=0) for readers that
+    # predate the draft axis.
+    if "self" in out["drafts"]:
+        out["ks"] = {0: baseline, **out["drafts"]["self"]["ks"]}
     return out
 
 
@@ -749,13 +831,28 @@ def main() -> None:
         "--spec-sweep",
         action="store_true",
         help="run the paged speculative-decoding sweep (spec_k = "
-        "--spec-ks, self-draft) instead of the attention microbench",
+        "--spec-ks crossed with the --spec-drafts draft axis) "
+        "instead of the attention microbench",
     )
     ap.add_argument(
         "--spec-ks",
         default="0,2,4",
         help="comma-separated spec_k values for --spec-sweep "
         "(0 = non-speculative baseline)",
+    )
+    ap.add_argument(
+        "--spec-drafts",
+        default="self,trunc:L/2,trunc:L/4,width:1/2",
+        help="comma-separated draft constructions for --spec-sweep: "
+        "self (acceptance 1), trunc:L/n (layer-truncated via "
+        "make_draft), width:p/q (head/FFN-pruned)",
+    )
+    ap.add_argument(
+        "--spec-window",
+        type=int,
+        default=1,
+        help="decode_window for --spec-sweep (W>1 prices the fused "
+        "spec x window path: W rounds per host dispatch)",
     )
     ap.add_argument(
         "--kv-quant-sweep",
@@ -862,7 +959,13 @@ def main() -> None:
             if v != ap.get_default(arg_of[k])
         }
         ks = tuple(int(k) for k in args.spec_ks.split(",") if k)
-        rec = run_spec_sweep(ks=ks, **shared)
+        drafts = tuple(d for d in args.spec_drafts.split(",") if d)
+        rec = run_spec_sweep(
+            ks=ks,
+            drafts=drafts,
+            decode_window=args.spec_window,
+            **shared,
+        )
     elif args.window_sweep:
         windows = tuple(
             int(k) for k in args.windows.split(",") if k

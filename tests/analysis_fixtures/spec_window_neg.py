@@ -1,0 +1,23 @@
+"""NEGATIVE: the fused spec x window shape that ships
+(runtime/paged.py::_tick_spec_window) — ONE jitted scan program runs
+all W draft+verify rounds on device, then a single batched drain of
+the per-round outputs, each transfer justified in place. The scan
+body itself (draft propose + verify forward + accept test + pend
+recurrence) never appears here: it is traced once, passed to the scan
+by value, and stays on device."""
+
+import numpy as np
+
+
+class Server:
+    def _tick(self):
+        toks, kept = self._window_program(self.params, self.state)
+        # analysis: ignore[host-sync-in-hot-loop] the ONE batched
+        # [B, W, k+1] token drain per fused window — W whole rounds
+        # amortize it
+        toks_host = np.asarray(toks)
+        # analysis: ignore[host-sync-in-hot-loop] kept-lengths half of
+        # the same batched window drain
+        kept_host = np.asarray(kept)
+        for r in range(toks_host.shape[1]):
+            self._commit(r, toks_host[:, r], kept_host[:, r])

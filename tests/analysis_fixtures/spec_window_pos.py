@@ -1,0 +1,18 @@
+"""POSITIVE: spec x decode_window composed WRONG — a python per-round
+loop inside the tick that pulls each round's proposals and verdicts
+to host as it goes, so a W-round window pays O(W) blocking
+device->host transfers (and re-dispatches the next round from host
+state) instead of running all W draft+verify rounds in ONE jitted
+scan and draining ONE batched [B, W, k+1] transfer at the end
+(runtime/paged.py::_tick_spec_window)."""
+
+import numpy as np
+
+
+class Server:
+    def _tick(self):
+        for r in range(self.decode_window):
+            props, preds = self._spec_round(r)
+            props_host = np.asarray(props)  # per-round pull
+            preds_host = np.asarray(preds)  # and its verdict twin
+            self._commit(r, props_host, preds_host)

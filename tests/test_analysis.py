@@ -29,6 +29,7 @@ CASES = [
     ("host-sync-in-hot-loop", "host_sync", 2),
     ("host-sync-in-hot-loop", "window_scan", 2),
     ("host-sync-in-hot-loop", "spec_accept", 2),
+    ("host-sync-in-hot-loop", "spec_window", 2),
     ("host-sync-in-hot-loop", "shard_map", 2),
     ("host-sync-in-hot-loop", "kv_spill", 2),
     ("fresh-closure-jit", "fresh_closure", 2),

@@ -1,0 +1,374 @@
+"""Real draft models + speculation composition (ISSUE 16).
+
+Two contracts, one file. (1) `models/transplant.py::make_draft`
+carves layer-truncated and width-pruned drafts out of a GPT target,
+and `DraftLanes` validates draft-vs-target geometry with the fix
+spelled out. (2) Every newly composed speculation path — spec x
+decode_window (fused rounds), spec on submit_prefilled admissions
+(disagg decode), spec under fleet routing, spec on a tp=2 mesh —
+emits greedy token streams BIT-IDENTICAL to spec_k=0, for a
+full-accept self-draft AND a divergent draft that forces the
+rejection/rewrite path every round.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from defer_tpu import obs
+from defer_tpu.models.gpt import GptDecoder, SamplingParams, tiny_gpt
+from defer_tpu.models.transplant import (
+    TransplantError,
+    draft_width_geometry,
+    make_draft,
+)
+from defer_tpu.runtime.decode_server import DraftLanes
+from defer_tpu.runtime.paged import PagedDecodeServer, serve_paged
+
+
+@pytest.fixture(scope="module")
+def model():
+    dec = tiny_gpt(64)
+    params = dec.init(jax.random.key(0))
+    return dec, params
+
+
+@pytest.fixture(scope="module")
+def gqa_model():
+    """GQA target (4 query heads sharing 2 kv heads) so width pruning
+    exercises the head-slicing path — tiny_gpt is MHA, where width
+    can only prune FFN."""
+    cfg = dataclasses.replace(
+        tiny_gpt(64).cfg, num_kv_heads=2, pos_style="rope"
+    )
+    dec = GptDecoder(cfg, compute_dtype=jnp.float32)
+    return dec, dec.init(jax.random.key(2))
+
+
+@pytest.fixture(scope="module")
+def divergent_draft():
+    dec = tiny_gpt(64)
+    return dec, dec.init(jax.random.key(7))
+
+
+def _requests(vocab):
+    rng = np.random.default_rng(11)
+    return [
+        (jnp.asarray(rng.integers(1, vocab, size=(1, 8)), jnp.int32), 9),
+        (jnp.asarray(rng.integers(1, vocab, size=(1, 3)), jnp.int32), 5),
+        (jnp.asarray(rng.integers(1, vocab, size=(1, 1)), jnp.int32), 7),
+        (jnp.asarray(rng.integers(1, vocab, size=(1, 5)), jnp.int32), 12),
+    ]
+
+
+def _sampling():
+    return [
+        None,
+        SamplingParams(temperature=0.9, seed=13),
+        None,
+        SamplingParams(temperature=1.0, top_k=8, seed=5),
+    ]
+
+
+@pytest.fixture(scope="module")
+def baseline(model):
+    dec, params = model
+    outs, stats = serve_paged(
+        dec, params, _requests(dec.cfg.vocab_size), num_blocks=24,
+        block_size=8, max_batch=2, sampling=_sampling(),
+    )
+    return outs, stats
+
+
+def _assert_parity(want, got, tag):
+    for j, (w, g) in enumerate(zip(want, got)):
+        np.testing.assert_array_equal(
+            np.asarray(g), np.asarray(w), err_msg=f"{tag} req={j}"
+        )
+
+
+# -- draft construction ----------------------------------------------------
+
+
+def test_make_draft_truncated_geometry(model):
+    dec, params = model
+    draft, dparams = make_draft(dec, params, layers=2)
+    assert draft.cfg.num_layers == 2
+    assert draft.cfg.dim == dec.cfg.dim
+    assert draft.cfg.vocab_size == dec.cfg.vocab_size
+    assert dparams["stack"]["wq"].shape[0] == 2
+    # Sliced layers are the target's own first layers, not copies of
+    # something else.
+    np.testing.assert_array_equal(
+        np.asarray(dparams["stack"]["wq"]),
+        np.asarray(params["stack"]["wq"][:2]),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(dparams["token_embedding"]),
+        np.asarray(params["token_embedding"]),
+    )
+    # The draft is a runnable decoder.
+    logits, _ = draft.make_step(donate=False)(
+        dparams, draft.init_cache(1), jnp.ones((1, 1), jnp.int32)
+    )
+    assert logits.shape == (1, 1, dec.cfg.vocab_size)
+
+
+def test_make_draft_width_pruned_geometry(gqa_model):
+    dec, params = gqa_model
+    heads, dim, ffn = draft_width_geometry(dec.cfg, 0.5)
+    assert heads == 2 and dim == 32 and ffn == 64
+    draft, dparams = make_draft(dec, params, width=0.5)
+    assert draft.cfg.num_heads == heads
+    assert draft.cfg.dim == dim
+    assert draft.cfg.ffn_dim == ffn
+    # KV width is invariant: the draft attends with the target's
+    # kv_heads (DraftLanes geometry contract).
+    assert draft.cfg.kv_heads == dec.cfg.kv_heads
+    assert draft.cfg.rope_theta == dec.cfg.rope_theta
+    st = dparams["stack"]
+    assert st["wq"].shape == (dec.cfg.num_layers, dim, dim)
+    assert st["w1"].shape == (dec.cfg.num_layers, dim, ffn)
+    logits, _ = draft.make_step(donate=False)(
+        dparams, draft.init_cache(1), jnp.ones((1, 1), jnp.int32)
+    )
+    assert logits.shape == (1, 1, dec.cfg.vocab_size)
+
+
+def test_make_draft_int8_and_errors(model):
+    dec, params = model
+    draft, dparams = make_draft(dec, params, layers=2, dtype="int8")
+    assert dparams["stack"]["wq"]["q"].dtype == jnp.int8
+    logits, _ = draft.make_step(donate=False)(
+        dparams, draft.init_cache(1), jnp.ones((1, 1), jnp.int32)
+    )
+    assert logits.shape == (1, 1, dec.cfg.vocab_size)
+    with pytest.raises(TransplantError, match="layers"):
+        make_draft(dec, params, layers=0)
+    with pytest.raises(TransplantError, match="layers"):
+        make_draft(dec, params, layers=99)
+    with pytest.raises(TransplantError, match="width"):
+        make_draft(dec, params, width=1.5)
+    with pytest.raises(TransplantError, match="quantized"):
+        make_draft(dec, dparams, layers=1)
+
+
+def test_draft_lanes_geometry_validation(model):
+    dec, params = model
+    bad_vocab = GptDecoder(
+        dataclasses.replace(dec.cfg, vocab_size=64), jnp.float32
+    )
+    with pytest.raises(ValueError, match="vocab_size.*make_draft"):
+        DraftLanes(
+            bad_vocab, bad_vocab.init(jax.random.key(1)), 2, target=dec
+        )
+    bad_kv = GptDecoder(
+        dataclasses.replace(dec.cfg, num_kv_heads=2), jnp.float32
+    )
+    with pytest.raises(ValueError, match="kv_heads.*width"):
+        DraftLanes(bad_kv, bad_kv.init(jax.random.key(1)), 2, target=dec)
+    bad_pos = GptDecoder(
+        dataclasses.replace(dec.cfg, pos_style="rope"), jnp.float32
+    )
+    with pytest.raises(ValueError, match="pos_style"):
+        DraftLanes(
+            bad_pos, bad_pos.init(jax.random.key(1)), 2, target=dec
+        )
+    rope = dataclasses.replace(dec.cfg, pos_style="rope")
+    rope_target = GptDecoder(rope, jnp.float32)
+    bad_theta = GptDecoder(
+        dataclasses.replace(rope, rope_theta=500000.0), jnp.float32
+    )
+    with pytest.raises(ValueError, match="rope_theta"):
+        DraftLanes(
+            bad_theta, bad_theta.init(jax.random.key(1)), 2,
+            target=rope_target,
+        )
+    # A transplant-carved draft passes by construction.
+    draft, dparams = make_draft(dec, params, layers=2)
+    DraftLanes(draft, dparams, 2, target=dec)
+
+
+# -- composed-path parity --------------------------------------------------
+
+
+@pytest.mark.parametrize("which_draft", ["self", "divergent", "trunc"])
+def test_spec_window_parity(model, divergent_draft, baseline, which_draft):
+    """Fused spec x decode_window: W whole draft+verify rounds per
+    host dispatch, token-identical to spec_k=0 for a full-accept
+    self-draft, an always-reject divergent draft, and a real
+    transplant-carved draft in between."""
+    dec, params = model
+    want, _ = baseline
+    draft, dparams = {
+        "self": lambda: model,
+        "divergent": lambda: divergent_draft,
+        "trunc": lambda: make_draft(dec, params, layers=2),
+    }[which_draft]()
+    outs, stats = serve_paged(
+        dec, params, _requests(dec.cfg.vocab_size), num_blocks=24,
+        block_size=8, max_batch=2, sampling=_sampling(),
+        spec_draft=draft, spec_params=dparams, spec_k=2,
+        decode_window=4,
+    )
+    _assert_parity(want, outs, f"spec-window {which_draft}")
+    assert stats["spec_rounds"] > 0
+    if which_draft == "divergent":
+        assert stats["spec_acceptance"] < 0.5
+
+
+def test_spec_window_dispatch_amortization(model):
+    """The acceptance criterion: W=4, k>=2 needs dispatches-per-token
+    <= 1/W of the k=0, W=1 baseline (the window fuses W two-forward
+    rounds into ONE dispatch, and each round commits up to k+1 tokens
+    per slot)."""
+    dec, params = model
+    req = [(jnp.asarray([[3, 9, 27]], jnp.int32), 17)]
+
+    def dispatches_per_token(**kwargs):
+        _, stats = serve_paged(
+            dec, params, req, num_blocks=16, block_size=8, max_batch=1,
+            **kwargs,
+        )
+        return stats["host_dispatches"] / 17
+
+    base = dispatches_per_token()
+    fused = dispatches_per_token(
+        spec_draft=dec, spec_params=params, spec_k=2, decode_window=4
+    )
+    assert fused <= base / 4
+    # k=0, W=1 pays ~one dispatch per token (the first token comes
+    # free at admission: 16 dispatches for 17 tokens).
+    assert base == pytest.approx(16 / 17)
+
+
+@pytest.mark.parametrize("which_draft", ["self", "divergent"])
+def test_spec_disagg_parity(model, divergent_draft, baseline, which_draft):
+    """Spec over submit_prefilled admissions: target KV arrives over
+    the wire, the draft lane re-prefills locally — greedy outputs
+    stay identical to the non-speculative split."""
+    from defer_tpu.disagg.api import serve_disagg
+
+    dec, params = model
+    want, _ = baseline
+    draft, dparams = (
+        model if which_draft == "self" else divergent_draft
+    )
+    outs, stats = serve_disagg(
+        dec, params, _requests(dec.cfg.vocab_size), num_blocks=24,
+        block_size=8, max_batch=2, sampling=_sampling(),
+        spec_draft=draft, spec_params=dparams, spec_k=3,
+    )
+    _assert_parity(want, outs, f"spec-disagg {which_draft}")
+    assert stats["disagg"] and stats["spec_rounds"] > 0
+    if which_draft == "divergent":
+        assert stats["spec_acceptance"] < 0.5
+
+
+@pytest.mark.parametrize("which_draft", ["self", "divergent"])
+def test_spec_fleet_parity(model, divergent_draft, baseline, which_draft):
+    """Spec under fleet routing: every replica speculates with its
+    own DraftLanes; outputs match single-server spec_k=0."""
+    from defer_tpu.fleet.api import serve_fleet
+
+    dec, params = model
+    want, _ = baseline
+    draft, dparams = (
+        model if which_draft == "self" else divergent_draft
+    )
+    outs, stats = serve_fleet(
+        dec, params, _requests(dec.cfg.vocab_size), n_replicas=2,
+        num_blocks=24, block_size=8, max_batch=2, sampling=_sampling(),
+        spec_draft=draft, spec_params=dparams, spec_k=3,
+    )
+    _assert_parity(want, outs, f"spec-fleet {which_draft}")
+    per = stats["replicas"]
+    assert sum(r["spec_rounds"] for r in per) > 0
+    assert all(r["spec_k"] == 3 for r in per)
+
+
+@pytest.mark.parametrize("decode_window", [1, 4])
+def test_spec_tp_parity(model, baseline, decode_window):
+    """Spec on a {"model": 2} mesh (draft replicated, verify forward
+    sharded), with and without the fused window — conftest provides 8
+    virtual CPU devices."""
+    from defer_tpu.parallel.mesh import make_mesh
+
+    dec, params = model
+    want, _ = baseline
+    mesh = make_mesh({"model": 2}, jax.devices()[:2])
+    outs, stats = serve_paged(
+        dec, params, _requests(dec.cfg.vocab_size), num_blocks=24,
+        block_size=8, max_batch=2, sampling=_sampling(), mesh=mesh,
+        spec_draft=dec, spec_params=params, spec_k=2,
+        decode_window=decode_window,
+    )
+    _assert_parity(want, outs, f"spec-tp W={decode_window}")
+    assert stats["mesh_shape"] == "model=2"
+    assert stats["spec_rounds"] > 0
+
+
+# -- satellite: lane release + obs -----------------------------------------
+
+
+def test_draft_lane_released_on_mid_round_finish(model):
+    """A slot finishing inside a spec round (eos mid-window) must
+    leave its draft lane FULLY cleared — pos zeroed and cache rows
+    zeroed — so the next tenant of the slot never attends over a dead
+    request's K/V."""
+    dec, params = model
+    req = (jnp.asarray([[11, 2, 8, 1, 6]], jnp.int32), 9)
+    base, _ = serve_paged(
+        dec, params, [req], num_blocks=16, block_size=8, max_batch=1
+    )
+    toks = np.asarray(base[0])[0]
+    eos = int(toks[req[0].shape[1] + 3])
+    srv = PagedDecodeServer(
+        dec, params, num_blocks=16, block_size=8, max_batch=1,
+        eos_id=eos, spec_draft=dec, spec_params=params, spec_k=4,
+    )
+    srv.submit(req[0], req[1])
+    srv.run()
+    assert srv._draft.pos[0] == 0
+    assert not np.asarray(srv._draft.ck[:, 0]).any()
+    assert not np.asarray(srv._draft.cv[:, 0]).any()
+    # release_all (the fleet replica-death path) clears every lane.
+    srv._draft.pos[0] = 7
+    srv._draft.ck = srv._draft.ck.at[:, 0].set(1.0)
+    srv._draft.release_all()
+    assert not srv._draft.pos.any()
+    assert not np.asarray(srv._draft.ck).any()
+
+
+def test_spec_obs_counters_and_histogram(model):
+    """Counter pins for the new obs surface: the draft-side forward
+    counter matches the stats field, and defer_spec_acceptance is a
+    HISTOGRAM of per-round accepted lengths (self-draft: every greedy
+    round observes exactly k, so sum == count * k)."""
+    dec, params = model
+    req = [(jnp.asarray([[3, 9, 27]], jnp.int32), 9)]
+    reg = obs.get_registry()
+    before = reg.value("defer_spec_acceptance", server="paged") or {
+        "count": 0,
+        "sum": 0.0,
+    }
+    with obs.counter_deltas() as d:
+        _, stats = serve_paged(
+            dec, params, req, num_blocks=16, block_size=8, max_batch=2,
+            spec_draft=dec, spec_params=params, spec_k=4,
+        )
+    assert stats["spec_draft_tokens"] > 0
+    assert (
+        d.get('defer_spec_draft_tokens_total{server="paged"}', 0)
+        == stats["spec_draft_tokens"]
+    )
+    after = reg.value("defer_spec_acceptance", server="paged")
+    n = after["count"] - before["count"]
+    s = after["sum"] - before["sum"]
+    # One observation per greedy-slot round (one slot here).
+    assert n == stats["spec_rounds"]
+    # Self-draft: every observed round accepted the full k proposals.
+    assert s == pytest.approx(n * 4)

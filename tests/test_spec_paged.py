@@ -203,11 +203,6 @@ def test_spec_constructor_and_submit_validation(model):
         PagedDecodeServer(dec, params, spec_draft=dec, **base)
     with pytest.raises(ValueError, match="spec_draft and spec_params"):
         PagedDecodeServer(dec, params, spec_k=2, **base)
-    with pytest.raises(ValueError, match="decode_window"):
-        PagedDecodeServer(
-            dec, params, spec_draft=dec, spec_params=params, spec_k=2,
-            decode_window=4, **base,
-        )
     with pytest.raises(ValueError, match="prefix_ids"):
         PagedDecodeServer(
             dec, params, spec_draft=dec, spec_params=params, spec_k=2,
@@ -225,11 +220,20 @@ def test_spec_constructor_and_submit_validation(model):
         dec, params, spec_draft=dec, spec_params=params, spec_k=4,
         **base,
     )
-    # Verify headroom: prompt + steps + spec_k must fit max_len.
+    # Verify headroom: prompt + steps + spec_k must fit max_len —
+    # on BOTH admission paths (a disagg decode worker speculates over
+    # ingested KV, so submit_prefilled takes the same check).
     with pytest.raises(ValueError, match="spec_k"):
         srv.submit(jnp.zeros((1, 8), jnp.int32), 56)
-    with pytest.raises(ValueError, match="prefilled admission"):
-        srv.submit_prefilled(jnp.zeros((1, 8), jnp.int32), 4)
+    with pytest.raises(ValueError, match="spec_k"):
+        srv.submit_prefilled(jnp.ones((1, 8), jnp.int32), 56)
+    # Lifted composition limits: spec x decode_window (fused rounds)
+    # and spec on prefilled admissions both construct/enqueue now.
+    PagedDecodeServer(
+        dec, params, spec_draft=dec, spec_params=params, spec_k=2,
+        decode_window=4, **base,
+    )
+    assert srv.submit_prefilled(jnp.ones((1, 8), jnp.int32), 4) >= 0
 
 
 @pytest.mark.parametrize(
