@@ -636,6 +636,29 @@ def bench_kv_quant(devices) -> dict:
     return rec
 
 
+def bench_constrain(devices) -> dict:
+    """Constrained decoding (scripts/bench_paged.py +
+    defer_tpu/constrain/): the same request mix served free vs
+    regex-constrained vs JSON-schema-constrained — pricing the
+    on-device DFA mask fold against the free baseline, the one-off
+    host compile (regex -> char DFA -> token lift -> prune) and the
+    mean fraction of the vocabulary the grammar removed per token."""
+    import importlib.util
+    import os
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "scripts",
+        "bench_paged.py",
+    )
+    spec = importlib.util.spec_from_file_location("bench_paged", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rec = mod.run_constrain_sweep(devices)
+    log(f"constrain sweep: {rec}")
+    return rec
+
+
 def bench_disagg(devices) -> dict:
     """Disaggregated serving (scripts/bench_disagg.py): the same
     request mix through monolithic serve_paged and split serve_disagg
@@ -1064,6 +1087,7 @@ def run_bench() -> dict:
             ("speculative", bench_speculative),
             ("tp_serving", bench_tp_serving),
             ("kv_quant", bench_kv_quant),
+            ("constrain", bench_constrain),
             ("disagg", bench_disagg),
             ("fleet", bench_fleet),
             ("bert_base", bench_bert),

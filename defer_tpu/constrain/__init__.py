@@ -1,0 +1,31 @@
+"""Grammar/JSON-schema constrained decoding (compiler + runtime).
+
+Host-side compile: `compile_regex(pattern, vocab)` /
+`compile_json_schema(schema, vocab)` lower a constraint spec into a
+dense token-level `TokenDFA` (dfa.py has the pipeline; schema.py the
+JSON-schema subset). Device-side serve: pass the compiled DFAs as
+`constraints={name: dfa}` to `DecodeServer` / `PagedDecodeServer`
+(or any serve_* front-end) and select per request with
+`SamplingParams(constraint=name)` — runtime.py documents the
+stacked-table mask fold the tick programs use.
+"""
+
+from defer_tpu.constrain.dfa import (
+    ConstraintError,
+    TokenDFA,
+    compile_regex,
+    prune_dead_states,
+)
+from defer_tpu.constrain.runtime import FREE_CID, stack_token_dfas
+from defer_tpu.constrain.schema import compile_json_schema, schema_to_regex
+
+__all__ = [
+    "ConstraintError",
+    "TokenDFA",
+    "compile_regex",
+    "compile_json_schema",
+    "schema_to_regex",
+    "prune_dead_states",
+    "stack_token_dfas",
+    "FREE_CID",
+]

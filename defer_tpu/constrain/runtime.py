@@ -1,0 +1,143 @@
+"""Device-side constraint runtime helpers shared by both servers.
+
+A server constructed with `constraints={name: TokenDFA}` stacks every
+DFA (plus one synthetic accept-everything DFA at index 0, the FREE
+row) into two padded device tables:
+
+    trans_all: int32 [C, S_max, V]   (-1 = inadmissible / padding)
+    acc_all:   bool  [C, S_max]
+
+Each slot then carries two int32 policy rows in SlotSampler —
+`cid` (which constraint; 0 = free) and `cstate` (current DFA state)
+— and the per-tick mask fold is one gather plus one where:
+
+    row  = trans_all[cid, cstate]            # [B, V]
+    mask = row >= 0; mask[:, eos] = acc      # eos iff accepting
+    ll   = where(mask, ll, finfo.min)
+    state' = max(row[nxt], 0)                # after sampling nxt
+
+For a FREE row the synthetic DFA makes `mask` all-True, so the fold
+is `where(True, ll, _)` — an exact bitwise no-op — which is what
+lets a mixed batch share one constrained program without perturbing
+its unconstrained rows. (Servers still trace the constrained
+program only while a constrained row is actually live, dispatched
+by a host flag like SlotSampler.row_sort, so `constraints=None`
+serving never sees these ops at all.)
+
+All helpers here are shape-polymorphic jnp code: they trace inside
+the jitted window/spec programs and run eagerly on the K=1 tick.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from defer_tpu.constrain.dfa import ConstraintError, TokenDFA
+
+#: cid value of an unconstrained slot (row 0 of the stacked tables).
+FREE_CID = 0
+
+
+def stack_token_dfas(
+    constraints: dict[str, TokenDFA], vocab_size: int
+) -> tuple[dict[str, int], jnp.ndarray, jnp.ndarray]:
+    """Validate + stack named DFAs into the padded device tables.
+    Returns (name -> cid, trans_all [C, S_max, V], acc_all [C, S_max]);
+    cid 0 is the synthetic free row, names take 1..C-1 sorted."""
+    if not constraints:
+        raise ConstraintError("constraints= given but empty")
+    for name, dfa in constraints.items():
+        if not isinstance(dfa, TokenDFA):
+            raise ConstraintError(
+                f"constraint {name!r} is {type(dfa).__name__}, "
+                "expected a constrain.TokenDFA"
+            )
+        if dfa.vocab_size != vocab_size:
+            raise ConstraintError(
+                f"constraint {name!r} compiled for vocab "
+                f"{dfa.vocab_size}, model vocab is {vocab_size}"
+            )
+    names = sorted(constraints)
+    s_max = max(
+        [1] + [constraints[n].num_states for n in names]
+    )
+    C = len(names) + 1
+    trans = np.full((C, s_max, vocab_size), -1, np.int32)
+    acc = np.zeros((C, s_max), bool)
+    # Free row: one state, every token loops, always accepting — the
+    # exact-no-op mask for unconstrained slots in a constrained batch.
+    trans[FREE_CID, 0, :] = 0
+    acc[FREE_CID, 0] = True
+    cids = {}
+    for k, name in enumerate(names, start=1):
+        dfa = constraints[name]
+        trans[k, : dfa.num_states] = dfa.transitions
+        acc[k, : dfa.num_states] = dfa.accepting
+        cids[name] = k
+    return cids, jnp.asarray(trans), jnp.asarray(acc)
+
+
+def resolve_constraint(name, ctrans, cnames, cdfas) -> int:
+    """Constraint name -> stacked-table cid, validating at submit
+    time (unknown names and start-state dead ends must fail the
+    caller, never wedge a slot). Shared by both servers'
+    `_resolve_constraint`."""
+    if name is None:
+        return FREE_CID
+    if ctrans is None:
+        raise ValueError(
+            f"sampling requests constraint {name!r} but the "
+            "server was built without constraints="
+        )
+    cid = cnames.get(name)
+    if cid is None:
+        raise ValueError(
+            f"unknown constraint {name!r}; registered: "
+            f"{sorted(cnames)}"
+        )
+    dfa = cdfas[cid]
+    if not dfa.accepting[dfa.start] and not (
+        dfa.transitions[dfa.start] >= 0
+    ).any():
+        raise ValueError(
+            f"constraint {name!r} admits no first token (dead "
+            "start state — compile via constrain.compile_regex "
+            "to get dead states pruned at build time)"
+        )
+    return cid
+
+
+def constrain_rows(trans_all, acc_all, cid, cstate):
+    """Per-slot transition row + accepting bit: ([B, V], [B])."""
+    return trans_all[cid, cstate], acc_all[cid, cstate]
+
+
+def constrain_mask(row, acc, eos_id: int):
+    """Admissibility mask [B, V]: table says yes, except the eos
+    column which is admitted exactly in accepting states."""
+    mask = row >= 0
+    return mask.at[:, eos_id].set(acc)
+
+
+def fold_mask(ll, mask):
+    """Mask-fold into the logits path; finfo.min (not -inf) so a
+    sampled row's softmax stays NaN-free even near a dead end."""
+    return jnp.where(mask, ll, jnp.finfo(ll.dtype).min)
+
+
+def advance_state(row, cstate, nxt, advance):
+    """Post-sample state update: rows with `advance` move to
+    row[nxt] (clamped — the eos/forced column may be -1), others
+    keep their state."""
+    new = jnp.take_along_axis(row, nxt[:, None].astype(jnp.int32), 1)[
+        :, 0
+    ]
+    return jnp.where(advance, jnp.maximum(new, 0), cstate)
+
+
+def masked_frac(mask, active):
+    """Fraction of the vocabulary the constraint masked off, per row
+    (float32 [B]); inactive rows report 0."""
+    frac = 1.0 - jnp.mean(mask, axis=-1, dtype=jnp.float32)
+    return jnp.where(active, frac, 0.0)

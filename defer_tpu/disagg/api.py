@@ -145,6 +145,7 @@ def serve_disagg(
     accept_timeout_s: float = 60.0,
     read_timeout_s: float | None = 60.0,
     connect_timeout_s: float = 30.0,
+    constraints: dict | None = None,
 ) -> tuple[list[jax.Array], dict]:
     """Disaggregated serving; same contract as `serve_paged` (outputs
     in submission order + ServerStats) with the prefill phase running
@@ -167,7 +168,12 @@ def serve_disagg(
     (PagedDecodeServer._admit_prefilled) — the draft's prefill is the
     cheap side of the compute asymmetry the disagg split exists for,
     so recompute beats shipping a second KV stream. Greedy outputs
-    stay token-identical to the non-speculative split."""
+    stay token-identical to the non-speculative split.
+
+    `constraints={name: TokenDFA}` registers compiled grammars on the
+    DECODE side (defer_tpu/constrain/; per-request opt-in via
+    `SamplingParams(constraint="name")`) — prefill ships plain K/V, so
+    the worker needs no DFA tables."""
     srv = server
     if srv is None:
         srv = PagedDecodeServer(
@@ -184,6 +190,7 @@ def serve_disagg(
             spec_k=spec_k,
             spec_draft=spec_draft,
             spec_params=spec_params,
+            constraints=constraints,
         )
     samps = sampling or [None] * len(requests)
     stops = stop or [None] * len(requests)
@@ -328,5 +335,7 @@ def serve_disagg(
         kv_bytes_recv_per_request=recv.rx_frame_bytes / n_req,
         dispatch_bytes_sent=dispatch_bytes_total,
         worker_restarts=restarts,
+        constrained_tokens=srv.constrained_tokens_n,
+        constraint_dead_ends=srv.constraint_dead_ends_n,
     )
     return [done[r] for r in rids], stats

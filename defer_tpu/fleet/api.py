@@ -94,6 +94,7 @@ class FleetFrontend:
         spawn_replica: Any = None,
         model_axis_size: int | None = None,
         devices: list | None = None,
+        constraints: dict | None = None,
     ):
         if n_replicas < 1:
             raise ValueError(f"need >= 1 replica, got {n_replicas}")
@@ -161,6 +162,7 @@ class FleetFrontend:
                 spec_k=spec_k,
                 spec_draft=spec_draft,
                 spec_params=spec_params,
+                constraints=constraints,
                 **_placement(i),
             )
 
@@ -357,6 +359,8 @@ class FleetFrontend:
                         else 0
                     ),
                     spill_hits=srv.spill_hits_n,
+                    constrained_tokens=srv.constrained_tokens_n,
+                    constraint_dead_ends=srv.constraint_dead_ends_n,
                     dead=str(r.dead) if r.dead is not None else None,
                 )
             )
@@ -400,6 +404,7 @@ def serve_fleet(
     model_axis_size: int | None = None,
     devices: list | None = None,
     result_timeout_s: float = 600.0,
+    constraints: dict | None = None,
 ) -> tuple[list[jax.Array], dict]:
     """One-shot fleet serving; same contract as `serve_paged` (outputs
     in submission order + stats) over `n_replicas` paged servers, each
@@ -428,7 +433,16 @@ def serve_fleet(
     concept the draft does not share), so a migrated admission
     speculates exactly like a local one. A dying replica's draft
     lanes are torn down with its pool (`DraftLanes.release_all` in
-    the replica loop's failure path)."""
+    the replica loop's failure path).
+
+    `constraints={name: TokenDFA}` registers compiled grammars on
+    EVERY replica (defer_tpu/constrain/): each replica stacks its own
+    device copy of the DFA tables, so a request opting in via
+    `SamplingParams(constraint="name")` decodes constrained on
+    whichever replica the router picks (migration ships prefix
+    blocks, never sampler state — a request's DFA walk lives and dies
+    on its admitting replica). Per-replica ServerStats then carry
+    `constrained_tokens` / `constraint_dead_ends`."""
     fe = FleetFrontend(
         dec,
         params,
@@ -453,6 +467,7 @@ def serve_fleet(
         spawn_replica=spawn_replica,
         model_axis_size=model_axis_size,
         devices=devices,
+        constraints=constraints,
     )
     samps = sampling or [None] * len(requests)
     stops = stop or [None] * len(requests)

@@ -120,13 +120,21 @@ class SamplingParams:
     """Per-request sampling policy for the serving stack
     (runtime/decode_server.py, runtime/paged.py): the same knobs
     `generate` takes, plus the seed that makes a server slot reproduce
-    the solo stream exactly. temperature 0 = greedy (filters unused)."""
+    the solo stream exactly. temperature 0 = greedy (filters unused).
+
+    `constraint` names a server-registered constraint DFA
+    (defer_tpu/constrain/; servers take `constraints={name: dfa}`):
+    the slot's logits are masked to grammar-admissible tokens every
+    tick, composing with any temperature/filter setting — including
+    the temperature-0 greedy fast path, which stays greedy over the
+    masked logits."""
 
     temperature: float = 0.0
     top_k: int = 0
     top_p: float = 1.0
     min_p: float = 0.0
     seed: int = 0
+    constraint: str | None = None
 
     def validate(self) -> None:
         if self.temperature < 0:

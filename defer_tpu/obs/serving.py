@@ -229,6 +229,29 @@ class ServingMetrics:
             tuple(float(b) for b in range(17)),
             labels,
         )
+        # Constrained decoding (defer_tpu/constrain/): tokens emitted
+        # under a DFA mask, and how much of the vocabulary that mask
+        # removed per token — masked_frac near 1.0 means the grammar
+        # is doing almost all the choosing (JSON punctuation states),
+        # near 0.0 means the constraint is along for the ride.
+        self.constrained_tokens = reg.counter(
+            "defer_constrained_tokens_total",
+            "Tokens emitted by slots decoding under a constraint DFA "
+            "mask (defer_tpu/constrain/)", labels,
+        )
+        self.constrain_masked_frac = reg.histogram(
+            "defer_constrain_masked_frac",
+            "Per-token fraction of the vocabulary the constraint "
+            "mask removed (1.0 = grammar-forced, 0.0 = free)",
+            tuple(i / 10.0 for i in range(1, 11)),
+            labels,
+        )
+        self.constrain_dead_ends = reg.counter(
+            "defer_constrain_dead_ends_total",
+            "Requests failed because their (hand-built) constraint "
+            "DFA reached a state admitting no token — compiled DFAs "
+            "are dead-end-free by construction", labels,
+        )
 
 
 class DisaggMetrics:

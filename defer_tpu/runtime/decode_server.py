@@ -55,6 +55,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from defer_tpu.constrain import runtime as crt
 from defer_tpu.models.gpt import (
     sample_token_batched,
     sample_token_batched_nosort,
@@ -96,6 +97,20 @@ class SlotSampler:
         # costs the batch the sorting path only while it is actually
         # live.
         self.row_sort = [False] * max_batch
+        # Host mirror of "this row installed truncation filters"
+        # (top_k/top_p/min_p): release() must reset those device rows
+        # too — see release() — and the mirror keeps the greedy
+        # common case free of device writes.
+        self.row_filters = [False] * max_batch
+        # Constrained decoding (defer_tpu/constrain/): per-slot DFA
+        # policy rows — which stacked constraint table (cid, 0 = the
+        # free accept-everything row) and the current DFA state. The
+        # host mirror routes ticks through the constrained program
+        # variants only while a constrained row is live (the row_sort
+        # dispatch pattern).
+        self.cid = jnp.zeros((max_batch,), jnp.int32)
+        self.cstate = jnp.zeros((max_batch,), jnp.int32)
+        self.row_constrained = [False] * max_batch
 
     def admit_first(self, i, samp, logits_row, dtype):
         """First generated token of an admission [1, 1]: greedy
@@ -124,7 +139,19 @@ class SlotSampler:
         self.minp = self.minp.at[i].set(samp.min_p)
         self.row_temp[i] = samp.temperature
         self.row_sort[i] = samp.top_k > 0 or samp.top_p < 1.0
+        self.row_filters[i] = (
+            samp.top_k > 0 or samp.top_p < 1.0 or samp.min_p > 0.0
+        )
         return tok[:, None].astype(dtype)
+
+    def admit_constraint(self, i: int, cid, state) -> None:
+        """Install slot i's constraint policy rows (cid into the
+        server's stacked DFA tables, state AFTER the admission's first
+        token — a device scalar, no sync). The host mirror routes
+        later ticks through the constrained program variants."""
+        self.cid = self.cid.at[i].set(cid)
+        self.cstate = self.cstate.at[i].set(state)
+        self.row_constrained[i] = True
 
     def release(self, i: int) -> None:
         """Retire slot i's sampling policy the moment its request
@@ -132,14 +159,28 @@ class SlotSampler:
         reused: a stale row_sort=True would keep routing every tick
         through the sorting sampler long after the top-k request is
         gone, and a stale temperature would route the idle row's dummy
-        draw through the categorical path. Greedy rows (temp 0, no
-        sort) are already released — the common case stays free of
-        device writes. Idle rows' keys keep advancing in draw(), which
-        is fine: admission re-seeds them."""
+        draw through the categorical path. ALL policy rows reset —
+        temperature AND the top_k/top_p/min_p filter rows (a greedy
+        re-admit into a vacated sampled slot routes through the argmax
+        path, but a later sampled temp-only admit into that slot would
+        otherwise inherit the dead request's filters) AND the
+        constraint rows. Greedy unconstrained rows are already
+        released — the common case stays free of device writes. Idle
+        rows' keys keep advancing in draw(), which is fine: admission
+        re-seeds them."""
         self.row_sort[i] = False
         if self.row_temp[i] != 0.0:
             self.temp = self.temp.at[i].set(0.0)
             self.row_temp[i] = 0.0
+        if self.row_filters[i]:
+            self.topk = self.topk.at[i].set(0)
+            self.topp = self.topp.at[i].set(1.0)
+            self.minp = self.minp.at[i].set(0.0)
+            self.row_filters[i] = False
+        if self.row_constrained[i]:
+            self.cid = self.cid.at[i].set(0)
+            self.cstate = self.cstate.at[i].set(0)
+            self.row_constrained[i] = False
 
     def draw(self, logits_last):
         """One batched draw over every slot's policy (B,): sampled
@@ -347,11 +388,104 @@ class DraftLanes:
 
         return propose
 
+    def _propose_body_c(self, k: int, eos: int):
+        """Constrained propose body (defer_tpu/constrain/): the same
+        catch-up + k-step greedy scan, but each proposal argmax is
+        masked by the slot's DFA row and a LOCAL DFA state walks
+        forward with the proposals — so a constrained slot's draft
+        chain stays inside its grammar and the target's accept rule
+        sees grammar-valid candidates instead of rejecting everything
+        at position 0. Free rows (cid 0) fold an all-True mask: their
+        proposals are bit-identical to _propose_body's. A dead local
+        state needs no special case: its garbage argmax can never
+        match the target's forced out-of-vocab pred, so acceptance
+        truncates there."""
+        from defer_tpu.constrain import runtime as crt
+
+        raw = self.dec.decode_step_fn()
+
+        def propose(params, dk, dv, dpos, feed2, adv, cid, cstate,
+                    ctrans, cacc):
+            cvec = cid > 0
+            cache = {"k": dk, "v": dv, "pos": dpos}
+            logits2, cache = raw(params, cache, feed2)
+            first_l = jnp.take_along_axis(
+                logits2,
+                jnp.maximum(adv - 1, 0)[:, None, None],
+                axis=1,
+            )[:, 0, :]
+            crow, acc = crt.constrain_rows(ctrans, cacc, cid, cstate)
+            cmask = crt.constrain_mask(crow, acc, eos)
+            nxt = jnp.argmax(
+                crt.fold_mask(first_l, cmask), axis=-1
+            ).astype(jnp.int32)
+            cstate = crt.advance_state(crow, cstate, nxt, cvec)
+            pos1 = dpos + adv
+
+            def body(carry, _):
+                ck, cv, pos, tok, cs = carry
+                lg, c2 = raw(
+                    params,
+                    {"k": ck, "v": cv, "pos": pos},
+                    tok[:, None],
+                )
+                crow, acc = crt.constrain_rows(ctrans, cacc, cid, cs)
+                cmask = crt.constrain_mask(crow, acc, eos)
+                t2 = jnp.argmax(
+                    crt.fold_mask(lg[:, -1, :], cmask), axis=-1
+                ).astype(jnp.int32)
+                cs = crt.advance_state(crow, cs, t2, cvec)
+                return (c2["k"], c2["v"], c2["pos"], t2, cs), t2
+
+            (dk, dv, _, _, _), rest = lax.scan(
+                body,
+                (cache["k"], cache["v"], pos1, nxt, cstate),
+                None,
+                length=k - 1,
+            )
+            props = jnp.concatenate([nxt[:, None], rest.T], axis=1)
+            return dk, dv, props
+
+        return propose
+
     def _build_propose(self, k: int):
         def build():
             return jax.jit(self._propose_body(k), donate_argnums=(1, 2))
 
         return cached_step(self.dec, ("spec_propose", self.B, k), build)
+
+    def _build_propose_c(self, k: int, eos: int):
+        def build():
+            return jax.jit(
+                self._propose_body_c(k, eos), donate_argnums=(1, 2)
+            )
+
+        return cached_step(
+            self.dec, ("spec_propose_c", self.B, k, eos), build
+        )
+
+    def propose_c(self, k, posm, feed2, adv, eos, cid, cstate,
+                  ctrans, cacc):
+        """Constrained twin of propose() (separate memo key — the
+        unconstrained program is untouched): proposals are masked by
+        each slot's DFA walk (_propose_body_c). `cstate` is the
+        server's COMMITTED per-slot state — every emitted token is
+        already folded in, so the local walk continues exactly where
+        the target's mask will check."""
+        prog = self._build_propose_c(k, eos)
+        self.ck, self.cv, props = prog(
+            self.params,
+            self.ck,
+            self.cv,
+            jnp.asarray(posm, jnp.int32),
+            jnp.asarray(feed2, jnp.int32),
+            jnp.asarray(adv, jnp.int32),
+            cid,
+            cstate,
+            ctrans,
+            cacc,
+        )
+        return props
 
     def propose(self, k, posm, feed2, adv):
         """One fused draft dispatch: catch up on pending committed
@@ -382,6 +516,7 @@ class _Slot:
     toks: list | None = None
     sampling: bool = False  # this request runs at temperature > 0
     stop: Any = None  # per-request StopMatcher (runtime/stopping.py)
+    cid: int = 0  # stacked-constraint index (0 = unconstrained)
 
 
 class DecodeServer:
@@ -398,11 +533,23 @@ class DecodeServer:
         on_token: Any = None,
         eos_id: int | None = None,
         decode_window: int = 1,
+        constraints: dict | None = None,
     ):
         """`on_token(request_id, token_id, done)` — optional streaming
         callback fired for every generated token as its batched tick
         resolves (`done=True` on the request's final token). Keep it
         cheap: it runs on the serving thread between ticks.
+
+        `constraints` — named constraint DFAs ({name:
+        constrain.TokenDFA}, compiled against this decoder's
+        vocabulary) a request selects with
+        SamplingParams(constraint=name): that slot's logits are
+        masked to grammar-admissible tokens (eos admitted only in
+        accepting states) before argmax/categorical, and the DFA
+        state advances on device inside the same tick/window
+        programs. Requires `eos_id` (a satisfied constraint must be
+        able to stop). With the default None, every traced program is
+        byte-identical to a server built before this feature existed.
 
         `eos_id` — stop token: a request that emits it finishes
         immediately (its output ends with the eos) and its slot
@@ -479,6 +626,33 @@ class DecodeServer:
             pre = dec.init_cache(1)
             _, pre = self.step(params, pre, prefix_ids)
             self._prefix_cache = pre
+        # Constrained decoding tables (defer_tpu/constrain/): stacked
+        # [C, S_max, V] transitions + [C, S_max] accepting bits, cid 0
+        # the synthetic free row. None when the feature is off — every
+        # tick then takes the exact pre-constraint code path.
+        self._ctrans = None
+        self._cacc = None
+        self._cnames: dict[str, int] = {}
+        self._cdfas: list = [None]
+        if constraints is not None:
+            if eos_id is None:
+                raise ValueError(
+                    "constraints= requires eos_id: a satisfied "
+                    "constraint stops by emitting eos"
+                )
+            self._cnames, self._ctrans, self._cacc = (
+                crt.stack_token_dfas(constraints, dec.cfg.vocab_size)
+            )
+            self._cdfas += [
+                constraints[n]
+                for n in sorted(self._cnames, key=self._cnames.get)
+            ]
+        # Per-request constraint failures (hand-built DFA dead ends):
+        # rid -> message. The slot finishes cleanly; compiled DFAs
+        # never land here (dfa.py prunes dead states).
+        self.errors: dict[int, str] = {}
+        self.constrained_tokens_n = 0
+        self.constraint_dead_ends_n = 0
         self.slots = [_Slot() for _ in range(max_batch)]
         # Persistent tick feed: each slot's next input token lives in
         # row i, updated by .at[i].set at admission and one
@@ -531,8 +705,12 @@ class DecodeServer:
         sequence — the multi-token generalization of `eos_id`."""
         if prompt_ids.shape[0] != 1:
             raise ValueError("submit one request at a time ([1, T])")
+        cid = 0
         if sampling is not None:
             sampling.validate()
+            # The constraint survives the greedy normalization below:
+            # temperature-0 JSON mode is the common case.
+            cid = self._resolve_constraint(sampling.constraint)
             if sampling.temperature == 0:
                 sampling = None  # greedy: keep the argmax fast path
         stop_seqs = normalize_stops(stop)
@@ -568,11 +746,16 @@ class DecodeServer:
         self._next_id += 1
         self.pending.append(
             (rid, prompt_ids, num_steps, adapter_id, sampling,
-             stop_seqs)
+             stop_seqs, cid)
         )
         self.solo_steps += num_steps
         self._submit_t[rid] = time.perf_counter()
         return rid
+
+    def _resolve_constraint(self, name: str | None) -> int:
+        return crt.resolve_constraint(
+            name, self._ctrans, self._cnames, self._cdfas
+        )
 
     def run(self) -> dict[int, jax.Array]:
         """Serve until every submitted request completes; returns
@@ -589,7 +772,7 @@ class DecodeServer:
             if slot.req is not None or not self.pending:
                 continue
             (rid, prompt, steps, adapter_id, samp,
-             stop_seqs) = self.pending.pop(0)
+             stop_seqs, cid) = self.pending.pop(0)
             t0 = prompt.shape[1]
             self.obs.requests_admitted.inc()
             self.obs.prefill_tokens.inc(t0)
@@ -613,12 +796,11 @@ class DecodeServer:
                 last, small = self.dec.prefill(
                     self.params, small, prompt, chunk=win
                 )
-                first = self._sampler.admit_first(
-                    i, samp, last, prompt.dtype
-                )
+                first = self._first_token(i, samp, last, prompt.dtype,
+                                          cid)
                 self._install_lane(
                     i, slot, rid, steps, prompt, small, first,
-                    t0, adapter_id, samp, stop_seqs,
+                    t0, adapter_id, samp, stop_seqs, cid,
                 )
                 continue
             # Bucketed prefill keeps the compiled-shape set small.
@@ -648,17 +830,38 @@ class DecodeServer:
                 logits, small = self.dec.make_step(donate=False)(
                     self.params, small, padded
                 )
-            first = self._sampler.admit_first(
-                i, samp, logits[:, t0 - 1, :], prompt.dtype
+            first = self._first_token(
+                i, samp, logits[:, t0 - 1, :], prompt.dtype, cid
             )
             self._install_lane(
                 i, slot, rid, steps, prompt, small, first,
-                P + t0, adapter_id, samp, stop_seqs,
+                P + t0, adapter_id, samp, stop_seqs, cid,
             )
+
+    def _first_token(self, i, samp, lrow, dtype, cid):
+        """Admission's first generated token: constrained slots mask
+        the prefill logits row with their DFA's START-state row before
+        the shared argmax/first-draw, then install the advanced state
+        (a device scalar — admission stays sync-free beyond its
+        existing bookkeeping)."""
+        if cid:
+            row = self._ctrans[cid, 0]
+            mask = (row >= 0).at[self.eos_id].set(self._cacc[cid, 0])
+            lrow = jnp.where(mask[None, :], lrow,
+                             jnp.finfo(lrow.dtype).min)
+        first = self._sampler.admit_first(i, samp, lrow, dtype)
+        if cid:
+            state = jnp.maximum(row[first[0, 0].astype(jnp.int32)], 0)
+            self._sampler.admit_constraint(i, cid, state)
+            frac = crt.masked_frac(mask[None, :], jnp.asarray([True]))
+            self.obs.constrain_masked_frac.observe(float(frac[0]))
+            self.obs.constrained_tokens.inc()
+            self.constrained_tokens_n += 1
+        return first
 
     def _install_lane(
         self, i, slot, rid, steps, prompt, small, first, pos_val,
-        adapter_id, samp=None, stop_seqs=(),
+        adapter_id, samp=None, stop_seqs=(), cid=0,
     ) -> None:
         """The one admission tail both prefill paths share: insert the
         prefilled lane into slot i (rows past pos_val are stale but
@@ -692,6 +895,7 @@ class DecodeServer:
         slot.toks = [prompt, first]
         slot.sampling = samp is not None
         slot.stop = matcher_or_none(stop_seqs)
+        slot.cid = cid
         self._feed = self._feed.at[i].set(first[0].astype(jnp.int32))
         need_host = (
             self.eos_id is not None
@@ -734,12 +938,36 @@ class DecodeServer:
         mask = jnp.asarray(active)
         cache = {**cache, "pos": jnp.where(mask, cache["pos"], 0)}
         self.cache = cache
+        ll = logits[:, -1, :]
+        sm = self._sampler
+        # Constrained rows (defer_tpu/constrain/): fold the DFA mask
+        # into the batched logits BEFORE argmax/draw, advance states
+        # after. Guarded by the host mirror so unconstrained serving
+        # dispatches the exact pre-constraint op sequence.
+        constrained = any(sm.row_constrained)
+        if constrained:
+            crow, cacc = crt.constrain_rows(
+                self._ctrans, self._cacc, sm.cid, sm.cstate
+            )
+            cmask = crt.constrain_mask(crow, cacc, self.eos_id)
+            cvec = jnp.asarray(sm.row_constrained)
+            # Dead end (hand-built DFAs only — dfa.py prunes): no
+            # admissible token. Force eos so the row freezes; the
+            # drain drops the forced token and surfaces the error.
+            dead = cvec & mask & ~cmask.any(-1)
+            ll = crt.fold_mask(ll, cmask)
         if any(
             s.req is not None and s.sampling for s in self.slots
         ):
-            nxt = self._sampler.draw(logits[:, -1, :])
+            nxt = self._sampler.draw(ll)
         else:
-            nxt = jnp.argmax(logits[:, -1, :], axis=-1)  # (B,)
+            nxt = jnp.argmax(ll, axis=-1)  # (B,)
+        if constrained:
+            nxt = jnp.where(dead, self.eos_id, nxt)
+            sm.cstate = crt.advance_state(
+                crow, sm.cstate, nxt, cvec & ~dead
+            )
+            mfrac = crt.masked_frac(cmask, cvec & mask)
         self._feed = nxt[:, None].astype(jnp.int32)
         # One device->host transfer per tick for streaming/eos/stop
         # matching, not one blocking int() per slot.
@@ -756,9 +984,36 @@ class DecodeServer:
         # when an eos/stop/stream consumer needs host tokens — the
         # sync this serving loop is designed around
         host_nxt = np.asarray(nxt) if need_host else None
+        if constrained:
+            # analysis: ignore[host-sync-in-hot-loop] one batched
+            # per-tick transfer of the dead-end flags + mask
+            # fractions, and only while a constrained row is live
+            dead_host = np.asarray(dead)
+            # analysis: ignore[host-sync-in-hot-loop] ready with the
+            # vector above (same sync point)
+            mfrac_host = np.asarray(mfrac)
         for i, slot in enumerate(self.slots):
             if slot.req is None:
                 continue
+            if constrained and slot.cid:
+                if bool(dead_host[i]):
+                    # The forced eos never enters the output: the
+                    # request ends at its last admissible token with
+                    # a per-request error, not a hang.
+                    self.errors[slot.req] = (
+                        "constraint dead end: DFA state admits no "
+                        "token and is not accepting"
+                    )
+                    self.constraint_dead_ends_n += 1
+                    self.obs.constrain_dead_ends.inc()
+                    slot.remaining = 0
+                    self._finish(i, slot)
+                    continue
+                self.constrained_tokens_n += 1
+                self.obs.constrained_tokens.inc()
+                self.obs.constrain_masked_frac.observe(
+                    float(mfrac_host[i])
+                )
             tok = nxt[i][None, None].astype(slot.last.dtype)
             slot.last = tok
             slot.toks.append(tok)
@@ -841,6 +1096,85 @@ class DecodeServer:
             self.dec, ("flat_window", K, mode, eos), build
         )
 
+    def _build_window_c(self, mode: str):
+        """Constrained variant of the fused window program: same scan
+        skeleton plus the per-sub-step DFA gather/mask-fold/advance
+        (constrain/runtime.py). A SEPARATE memo key — the
+        unconstrained program stays byte-identical to pre-constraint
+        builds, and a constrained server only pays this trace while a
+        constrained row is actually live (_tick_window dispatch).
+        Extra outputs: final DFA states, a per-row "hit a dead end"
+        flag (hand-built DFAs only; the forced-eos token is dropped on
+        drain) and the [B, K] masked-fraction buffer for obs."""
+        K = self.decode_window
+        eos = self.eos_id
+        dec = self.dec
+
+        def build():
+            raw = dec.decode_step_fn()
+
+            def window(params, cache, feed, active, keys, temp,
+                       topk, topp, minp, budget, cid, cstate,
+                       ctrans, cacc):
+                cvec = cid > 0
+
+                def body(carry, _):
+                    cache, feed, active, keys, n, cstate, died = carry
+                    logits, cache = raw(params, cache, feed)
+                    cache = {
+                        **cache,
+                        "pos": jnp.where(active, cache["pos"], 0),
+                    }
+                    ll = logits[:, -1, :]
+                    crow, acc = crt.constrain_rows(
+                        ctrans, cacc, cid, cstate
+                    )
+                    cmask = crt.constrain_mask(crow, acc, eos)
+                    dead = cvec & active & ~cmask.any(-1)
+                    ll = crt.fold_mask(ll, cmask)
+                    if mode == "argmax":
+                        nxt = jnp.argmax(ll, axis=-1)
+                    elif mode == "nosort":
+                        nxt, keys = sample_token_batched_nosort(
+                            ll, keys, temp, minp
+                        )
+                    else:
+                        nxt, keys = sample_token_batched(
+                            ll, keys, temp, topk, topp, minp
+                        )
+                    nxt = jnp.where(dead, eos, nxt)
+                    cstate = crt.advance_state(
+                        crow, cstate, nxt, cvec & ~dead
+                    )
+                    frac = crt.masked_frac(cmask, cvec & active)
+                    n = n + active.astype(jnp.int32)
+                    alive = active & (n < budget) & (nxt != eos)
+                    feed = nxt[:, None].astype(jnp.int32)
+                    carry = (
+                        cache, feed, alive, keys, n, cstate,
+                        died | dead,
+                    )
+                    return carry, (nxt, frac)
+
+                init = (
+                    cache, feed, active, keys,
+                    jnp.zeros_like(budget), cstate,
+                    jnp.zeros_like(cvec),
+                )
+                (cache, feed, alive, keys, n, cstate, died), (
+                    toks, fracs
+                ) = lax.scan(body, init, None, length=K)
+                return (
+                    cache, feed, alive, keys, n, toks.T, cstate,
+                    died, fracs.T,
+                )
+
+            return jax.jit(window, donate_argnums=(1,))
+
+        return cached_step(
+            self.dec, ("flat_window_c", K, mode, eos), build
+        )
+
     def _tick_window(self) -> None:
         """One fused dispatch of up to decode_window tokens per active
         slot; ONE batched host transfer drains the [B, K] token buffer
@@ -859,17 +1193,30 @@ class DecodeServer:
             mode = "sort"
         else:
             mode = "nosort"
-        window = self._build_window(mode)
         budget = [
             s.remaining if s.req is not None else 0
             for s in self.slots
         ]
         sm = self._sampler
-        cache, feed, alive, keys, n_dev, toks = window(
-            self.params, self.cache, self._feed,
-            jnp.asarray(active), sm.keys, sm.temp, sm.topk,
-            sm.topp, sm.minp, jnp.asarray(budget, jnp.int32),
-        )
+        constrained = any(sm.row_constrained)
+        died = fracs = None
+        if constrained:
+            window = self._build_window_c(mode)
+            (cache, feed, alive, keys, n_dev, toks, cstate, died,
+             fracs) = window(
+                self.params, self.cache, self._feed,
+                jnp.asarray(active), sm.keys, sm.temp, sm.topk,
+                sm.topp, sm.minp, jnp.asarray(budget, jnp.int32),
+                sm.cid, sm.cstate, self._ctrans, self._cacc,
+            )
+            sm.cstate = cstate
+        else:
+            window = self._build_window(mode)
+            cache, feed, alive, keys, n_dev, toks = window(
+                self.params, self.cache, self._feed,
+                jnp.asarray(active), sm.keys, sm.temp, sm.topk,
+                sm.topp, sm.minp, jnp.asarray(budget, jnp.int32),
+            )
         self.cache = cache
         self._feed = feed
         sm.keys = keys
@@ -903,11 +1250,21 @@ class DecodeServer:
         # [B, K] token transfer per window that replaces K per-tick
         # [B, 1] transfers — only when a stream/stop consumer exists
         toks_host = np.asarray(toks).tolist() if need_toks else None
+        died_host = fracs_host = None
+        if constrained:
+            # analysis: ignore[host-sync-in-hot-loop] rides the same
+            # per-window sync: batched dead-end flags + [B, K] mask
+            # fractions, only while a constrained row is live
+            died_host = np.asarray(died).tolist()
+            # analysis: ignore[host-sync-in-hot-loop] same per-window
+            # sync point (ready with the vector above)
+            fracs_host = np.asarray(fracs)
         self._drain_window(toks, toks_host, emitted, alive_host,
-                           budget)
+                           budget, died_host, fracs_host)
 
     def _drain_window(
-        self, toks, toks_host, emitted, alive_host, budget
+        self, toks, toks_host, emitted, alive_host, budget,
+        died_host=None, fracs_host=None,
     ) -> None:
         """Host-side window drain, per-token-equivalent to the K=1
         tick loop: stop sequences truncate the window's overshoot
@@ -925,8 +1282,17 @@ class DecodeServer:
             n_i = emitted[i]
             a_i = n_i
             stopped = False
+            dead = bool(
+                died_host is not None and died_host[i] and slot.cid
+            )
+            if dead:
+                # Dead-end DFA state mid-window: the device froze the
+                # row with a FORCED eos (counted in n_i) — drop it, so
+                # the output ends at the last admissible token and the
+                # failure surfaces as a per-request error, not a hang.
+                a_i = n_i - 1
             if slot.stop is not None:
-                hit = slot.stop.push_window(toks_host[i][:n_i])
+                hit = slot.stop.push_window(toks_host[i][:a_i])
                 if hit is not None:
                     a_i, stopped = hit, True
             accepted[i] = a_i
@@ -937,6 +1303,20 @@ class DecodeServer:
                 # eos froze the row on device, a stop sequence cut it
                 # on drain, or its budget ran out mid-window.
                 slot.remaining = 0
+            if dead:
+                slot.remaining = 0
+                self.errors[slot.req] = (
+                    "constraint dead end: DFA state admits no token "
+                    "and is not accepting"
+                )
+                self.constraint_dead_ends_n += 1
+                self.obs.constrain_dead_ends.inc()
+            if slot.cid and fracs_host is not None:
+                self.constrained_tokens_n += a_i
+                if a_i:
+                    self.obs.constrained_tokens.inc(a_i)
+                for fr in fracs_host[i][:a_i].tolist():
+                    self.obs.constrain_masked_frac.observe(fr)
             tok_block = toks[i, :a_i][None, :].astype(
                 slot.last.dtype
             )
@@ -966,6 +1346,7 @@ class DecodeServer:
         slot.last = None
         slot.sampling = False
         slot.stop = None
+        slot.cid = 0
         # Release the slot's sampling policy row NOW, not at reuse —
         # a lingering row_sort would drag every later tick through
         # the sorting sampler (SlotSampler.release).
@@ -982,6 +1363,7 @@ def serve_greedy(
     eos_id: int | None = None,
     sampling: list | None = None,
     decode_window: int = 1,
+    constraints: dict | None = None,
 ) -> tuple[list[jax.Array], dict]:
     """One-shot convenience: serve `[(prompt, steps), ...]`, returning
     outputs in submission order plus stats (`ticks` batched decode
@@ -998,10 +1380,15 @@ def serve_greedy(
     token-identical to the default K=1. Stats then also carry
     `decode_window`, `host_dispatches` (decode dispatches issued) and
     `tokens_per_dispatch` (mean tokens accepted per dispatch — the
-    dispatch-amortization win, approaching K * active slots)."""
+    dispatch-amortization win, approaching K * active slots).
+
+    `constraints={name: TokenDFA}` registers grammar constraints
+    (defer_tpu/constrain/) a request selects via
+    SamplingParams(constraint=name)."""
     srv = DecodeServer(
         dec, params, max_batch=max_batch, prefix_ids=prefix_ids,
         eos_id=eos_id, decode_window=decode_window,
+        constraints=constraints,
     )
     samps = sampling or [None] * len(requests)
     if len(samps) != len(requests):
@@ -1024,5 +1411,7 @@ def serve_greedy(
         tokens_per_dispatch=(
             srv.window_tokens / srv.dispatches if srv.dispatches else 0.0
         ),
+        constrained_tokens=srv.constrained_tokens_n,
+        constraint_dead_ends=srv.constraint_dead_ends_n,
     )
     return [done[r] for r in rids], stats

@@ -81,6 +81,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from defer_tpu.constrain import runtime as crt
 from defer_tpu.models.gpt import (
     sample_token_batched,
     sample_token_batched_nosort,
@@ -622,9 +623,23 @@ class PagedDecodeServer:
         mesh: Any = None,
         model_axis: str = "model",
         device: Any = None,
+        constraints: dict | None = None,
     ):
         """`on_token(request_id, token_id, done)` — optional streaming
         callback, same contract as the flat server's.
+
+        `constraints` — named constraint DFAs ({name:
+        constrain.TokenDFA}, compiled against this decoder's
+        vocabulary, defer_tpu/constrain/) a request selects with
+        SamplingParams(constraint=name): that slot's logits are masked
+        to grammar-admissible tokens (eos admitted only in accepting
+        states) before argmax/categorical, and the DFA state advances
+        on device inside the same tick/window/spec programs —
+        constrained greedy output is token-identical across
+        decode_window, spec_k, attention modes, and meshes. Requires
+        `eos_id` (a satisfied constraint must be able to stop). With
+        the default None every traced program is byte-identical to a
+        server built before this feature existed.
 
         `spec_k` — speculative decoding (ARCHITECTURE.md "Speculative
         serving"): a DRAFT decoder (`spec_draft`/`spec_params`, same
@@ -1007,6 +1022,38 @@ class PagedDecodeServer:
         self.obs.kv_pool_bytes.set(self.pool_bytes)
         self._submit_t: dict[int, float] = {}
         self._last_tick_t: float | None = None
+        # Constrained decoding tables (defer_tpu/constrain/): stacked
+        # [C, S_max, V] transitions + [C, S_max] accepting bits, cid 0
+        # the synthetic free row. None when the feature is off — every
+        # tick then takes the exact pre-constraint code path. The
+        # tables are replicated on a mesh (tiny next to the pool) and
+        # pinned with the params on a device= server.
+        self._ctrans = None
+        self._cacc = None
+        self._cnames: dict[str, int] = {}
+        self._cdfas: list = [None]
+        if constraints is not None:
+            if eos_id is None:
+                raise ValueError(
+                    "constraints= requires eos_id: a satisfied "
+                    "constraint stops by emitting eos"
+                )
+            self._cnames, self._ctrans, self._cacc = (
+                crt.stack_token_dfas(constraints, cfg.vocab_size)
+            )
+            if device is not None:
+                self._ctrans = jax.device_put(self._ctrans, device)
+                self._cacc = jax.device_put(self._cacc, device)
+            self._cdfas += [
+                constraints[n]
+                for n in sorted(self._cnames, key=self._cnames.get)
+            ]
+        # Per-request constraint failures (hand-built DFA dead ends):
+        # rid -> message. The slot finishes cleanly; compiled DFAs
+        # never land here (dfa.py prunes dead states).
+        self.errors: dict[int, str] = {}
+        self.constrained_tokens_n = 0
+        self.constraint_dead_ends_n = 0
         self._step = None
         self._insert = None
         self._insert_dyn = None
@@ -1138,8 +1185,12 @@ class PagedDecodeServer:
         blocks mid-budget."""
         if prompt_ids.ndim != 2 or prompt_ids.shape[0] != 1:
             raise ValueError("submit one request at a time ([1, T])")
+        cid = 0
         if sampling is not None:
             sampling.validate()
+            # The constraint survives the greedy normalization below:
+            # temperature-0 JSON mode is the common case.
+            cid = self._resolve_constraint(sampling.constraint)
             if sampling.temperature == 0:
                 sampling = None  # greedy: keep the argmax fast path
         stop_seqs = normalize_stops(stop)
@@ -1186,10 +1237,15 @@ class PagedDecodeServer:
         self._next_id += 1
         self.pending.append(
             (rid, prompt_ids, num_steps, adapter_id, sampling,
-             stop_seqs)
+             stop_seqs, cid)
         )
         self._submit_t[rid] = time.perf_counter()
         return rid
+
+    def _resolve_constraint(self, name: str | None) -> int:
+        return crt.resolve_constraint(
+            name, self._ctrans, self._cnames, self._cdfas
+        )
 
     def _own_need(self, t0: int, steps: int) -> int:
         """Blocks a request must own: its total span minus the shared
@@ -1236,8 +1292,10 @@ class PagedDecodeServer:
         prompt = np.asarray(prompt_ids)
         if prompt.ndim != 2 or prompt.shape[0] != 1:
             raise ValueError("submit one request at a time ([1, T])")
+        cid = 0
         if sampling is not None:
             sampling.validate()
+            cid = self._resolve_constraint(sampling.constraint)
             if sampling.temperature == 0:
                 sampling = None
         stop_seqs = normalize_stops(stop)
@@ -1268,6 +1326,7 @@ class PagedDecodeServer:
             "steps": num_steps,
             "samp": sampling,
             "stop": stop_seqs,
+            "cid": cid,
             "kv": None,
         }
         self._prefilled_order.append(rid)
@@ -2115,6 +2174,116 @@ class PagedDecodeServer:
             build,
         )
 
+    def _build_window_c(self, mode: str):
+        """Constrained variant of the fused paged window: the same
+        scan skeleton plus the per-sub-step DFA gather/mask-fold/state
+        advance (constrain/runtime.py). A SEPARATE memo key — the
+        unconstrained program stays byte-identical to pre-constraint
+        builds, and a constrained server pays this trace only while a
+        constrained row is live (_tick_window dispatch). On a mesh the
+        DFA tables ride in as replicated operands (tiny next to the
+        pool) so every shard advances identical constraint state.
+        Extra outputs: final DFA states, per-row dead-end flags
+        (hand-built DFAs only; the forced-eos token is dropped on
+        drain) and the [B, K] masked-fraction buffer for obs."""
+        from defer_tpu.utils.memo import cached_step
+
+        K = self.decode_window
+        eos = self.eos_id
+        bodies = {
+            "gathered": self._step_body,
+            "blockwise": self._step_body_blockwise,
+            "pallas": self._step_body_pallas,
+        }
+        body_builder = bodies[self.attention]
+
+        def build():
+            raw = body_builder()
+
+            def window(params, pk, pv, tables, pos, feed, active,
+                       keys, temp, topk, topp, minp, budget,
+                       adapter_ids, cid, cstate, ctrans, cacc):
+                cvec = cid > 0
+
+                def body(carry, _):
+                    (pk, pv, pos, feed, active, keys, n, cstate,
+                     died) = carry
+                    pos_eff = jnp.where(active, pos, 0)
+                    tab_eff = jnp.where(active[:, None], tables, 0)
+                    logits, pk, pv = raw(
+                        params, pk, pv, tab_eff, pos_eff, feed,
+                        adapter_ids,
+                    )
+                    ll = logits[:, -1, :]
+                    crow, acc = crt.constrain_rows(
+                        ctrans, cacc, cid, cstate
+                    )
+                    cmask = crt.constrain_mask(crow, acc, eos)
+                    dead = cvec & active & ~cmask.any(-1)
+                    ll = crt.fold_mask(ll, cmask)
+                    if mode == "argmax":
+                        nxt = jnp.argmax(ll, axis=-1)
+                    elif mode == "nosort":
+                        nxt, keys = sample_token_batched_nosort(
+                            ll, keys, temp, minp
+                        )
+                    else:
+                        nxt, keys = sample_token_batched(
+                            ll, keys, temp, topk, topp, minp
+                        )
+                    nxt = jnp.where(dead, eos, nxt)
+                    cstate = crt.advance_state(
+                        crow, cstate, nxt, cvec & ~dead
+                    )
+                    frac = crt.masked_frac(cmask, cvec & active)
+                    adv = active.astype(jnp.int32)
+                    pos = pos + adv
+                    n = n + adv
+                    alive = active & (n < budget) & (nxt != eos)
+                    feed = nxt[:, None].astype(jnp.int32)
+                    carry = (
+                        pk, pv, pos, feed, alive, keys, n, cstate,
+                        died | dead,
+                    )
+                    return carry, (nxt, frac)
+
+                init = (
+                    pk, pv, pos, feed, active, keys,
+                    jnp.zeros_like(budget), cstate,
+                    jnp.zeros_like(cvec),
+                )
+                (pk, pv, pos, feed, alive, keys, n, cstate, died), (
+                    toks, fracs
+                ) = lax.scan(body, init, None, length=K)
+                return (
+                    pk, pv, feed, alive, keys, n, toks.T, cstate,
+                    died, fracs.T,
+                )
+
+            if self.mesh is None:
+                return jax.jit(window, donate_argnums=(1, 2))
+            from jax.sharding import PartitionSpec as PSpec
+
+            from defer_tpu.utils.compat import shard_map
+
+            pool, r = self._pool_specs, PSpec()
+            sm = shard_map(
+                window,
+                self.mesh,
+                in_specs=(self._sdec._specs(), pool, pool)
+                + (r,) * 15,
+                out_specs=(pool, pool, r, r, r, r, r, r, r, r),
+                check_rep=False,
+            )
+            return jax.jit(sm, donate_argnums=(1, 2))
+
+        return cached_step(
+            self.dec,
+            ("paged_window_c", self.bs, self.attention, self.kv_dtype,
+             K, mode, eos, self._mesh_key),
+            build,
+        )
+
     def _build_spec_window(self, mode: str):
         """The fused spec x decode_window program: W = decode_window
         draft+verify rounds in ONE jitted dispatch. Each scan sub-step
@@ -2313,6 +2482,253 @@ class PagedDecodeServer:
         return cached_step(
             self.dec,
             ("paged_spec_window", self.bs, self.attention,
+             self.kv_dtype, W, k, mode, eos, draft.dec.cfg,
+             str(draft.dec.compute_dtype), self._mesh_key),
+            build,
+        )
+
+    def _build_spec_window_c(self, mode: str):
+        """Constrained variant of the fused spec window (SEPARATE memo
+        key — the unconstrained program stays byte-identical). Each
+        scan round swaps in the draft's DFA-masked propose body
+        (decode_server.py::_propose_body_c) and replays the
+        _constrained_preds target walk in-scan: position j's pred is
+        the masked argmax at the state reached via the proposal
+        prefix, dead states force the -1 sentinel so the on-device
+        accept mirror truncates there, and the emitted correction is
+        swapped for a forced eos that freezes the row (the drain
+        drops it and surfaces the per-request error — the
+        _build_window_c idiom). Committed DFA states ride the carry:
+        continuing greedy rows land on the post-state at their accept
+        length, sampled rows advance one step by their draw, so the
+        next round's draft + target walks resume from exactly the
+        states the host would have uploaded between unfused rounds.
+        Extra outputs: final states, per-row died flags, and the
+        [W, B, k+1] masked-fraction buffer for obs."""
+        from defer_tpu.utils.memo import cached_step
+
+        k = self.spec_k
+        W = self.decode_window
+        eos = self.eos_id
+        draft = self._draft
+
+        def build():
+            propose_raw = draft._propose_body_c(k, eos)
+            mt_raw = self._mt_body()
+
+            def window(params, pk, pv, dk, dv, dparams, tables, pos,
+                       dpos, feed, feed2, adv, active, sampling_row,
+                       keys, temp, topk, topp, minp, budget,
+                       adapter_ids, cid, cstate, ctrans, cacc):
+                B = pos.shape[0]
+                steps = jnp.arange(k + 1)
+                zero_from = jnp.zeros_like(pos)
+                cvec = cid > 0
+
+                def body(carry, _):
+                    (pk, pv, dk, dv, pos, dpos, feed, feed2, adv,
+                     active, keys, n, cstate, died) = carry
+                    greedy = active & ~sampling_row
+                    dpos_eff = jnp.where(greedy, dpos, 0)
+                    adv_eff = jnp.where(greedy, adv, 0)
+                    dk, dv, props = propose_raw(
+                        dparams, dk, dv, dpos_eff, feed2, adv_eff,
+                        cid, cstate, ctrans, cacc,
+                    )
+                    verify_in = jnp.concatenate(
+                        [feed, props.astype(jnp.int32)], axis=1
+                    )
+                    n_keep = jnp.where(
+                        active,
+                        jnp.where(sampling_row, 1, k + 1),
+                        0,
+                    ).astype(jnp.int32)
+                    pos_eff = jnp.where(active, pos, 0)
+                    tab_eff = jnp.where(active[:, None], tables, 0)
+                    logits, pk, pv = mt_raw(
+                        params, pk, pv, tab_eff, pos_eff, verify_in,
+                        n_keep, zero_from, adapter_ids,
+                    )
+                    # Target-side constrained walk along the proposal
+                    # prefix (_constrained_preds, in-scan).
+                    s = cstate
+                    preds_l, posts_l = [], []
+                    deads_l, fracs_l = [], []
+                    crow0 = cmask0 = None
+                    for j in range(k + 1):
+                        crow_j, acc_j = crt.constrain_rows(
+                            ctrans, cacc, cid, s
+                        )
+                        cmask_j = crt.constrain_mask(crow_j, acc_j, eos)
+                        if j == 0:
+                            crow0, cmask0 = crow_j, cmask_j
+                        dead_j = cvec & ~cmask_j.any(-1)
+                        p = jnp.argmax(
+                            crt.fold_mask(logits[:, j, :], cmask_j),
+                            axis=-1,
+                        ).astype(jnp.int32)
+                        p = jnp.where(dead_j, -1, p)
+                        preds_l.append(p)
+                        posts_l.append(
+                            crt.advance_state(
+                                crow_j, s, jnp.maximum(p, 0),
+                                cvec & ~dead_j,
+                            )
+                        )
+                        deads_l.append(dead_j)
+                        fracs_l.append(
+                            crt.masked_frac(cmask_j, cvec & active)
+                        )
+                        if j < k:
+                            s = crt.advance_state(
+                                crow_j, s, props[:, j], cvec
+                            )
+                    preds = jnp.stack(preds_l, 1)
+                    postm = jnp.stack(posts_l, 1)
+                    deadm = jnp.stack(deads_l, 1)
+                    fracm = jnp.stack(fracs_l, 1)
+                    mismatch = props != preds[:, :k]
+                    a = jnp.where(
+                        mismatch.any(axis=1),
+                        jnp.argmax(mismatch, axis=1),
+                        k,
+                    ).astype(jnp.int32)
+                    bonus = jnp.take_along_axis(
+                        preds, a[:, None], axis=1
+                    )[:, 0]
+                    dead_at = jnp.take_along_axis(
+                        deadm, a[:, None], axis=1
+                    )[:, 0]
+                    # The -1 sentinel never enters the stream: the
+                    # correction at a dead state becomes a forced eos
+                    # that freezes the row; the drain drops it.
+                    bonus = jnp.where(dead_at, eos, bonus)
+                    props_pad = jnp.concatenate(
+                        [props, jnp.zeros((B, 1), jnp.int32)], axis=1
+                    )
+                    toks = jnp.where(
+                        steps[None, :] < a[:, None],
+                        props_pad,
+                        bonus[:, None],
+                    )
+                    ll = crt.fold_mask(logits[:, 0, :], cmask0)
+                    if mode == "argmax":
+                        nxt = jnp.argmax(ll, axis=-1).astype(jnp.int32)
+                    elif mode == "nosort":
+                        nxt, keys = sample_token_batched_nosort(
+                            ll, keys, temp, minp
+                        )
+                    else:
+                        nxt, keys = sample_token_batched(
+                            ll, keys, temp, topk, topp, minp
+                        )
+                    nxt = nxt.astype(jnp.int32)
+                    nxt = jnp.where(deadm[:, 0], eos, nxt)
+                    toks = jnp.where(
+                        sampling_row[:, None], nxt[:, None], toks
+                    )
+                    cand = jnp.where(sampling_row, 1, a + 1)
+                    cand = jnp.where(active, cand, 0)
+                    kept = jnp.minimum(
+                        cand, jnp.maximum(budget - n, 0)
+                    )
+                    alive = active
+                    hit = (toks == eos) & (
+                        steps[None, :] < kept[:, None]
+                    )
+                    any_eos = hit.any(axis=1)
+                    kept = jnp.where(
+                        any_eos,
+                        jnp.argmax(hit, axis=1) + 1,
+                        kept,
+                    )
+                    alive = alive & ~any_eos
+                    # died only when the forced eos actually made the
+                    # kept prefix (an earlier natural eos or a budget
+                    # cut ends the row without the error).
+                    fpos = jnp.where(sampling_row, 0, a)
+                    died_now = jnp.where(
+                        sampling_row, deadm[:, 0], dead_at
+                    )
+                    died_now = (
+                        died_now & active & (kept == fpos + 1)
+                    )
+                    n = n + kept
+                    alive = alive & (n < budget)
+                    last = jnp.take_along_axis(
+                        toks, jnp.maximum(kept - 1, 0)[:, None], axis=1
+                    )[:, 0]
+                    feed = jnp.where(
+                        (kept > 0)[:, None], last[:, None], feed
+                    )
+                    pos = pos + kept
+                    full = a == k
+                    adv_next = jnp.where(full, 2, 1).astype(jnp.int32)
+                    f2a = jnp.where(full, props_pad[:, k - 1], last)
+                    upd = alive & ~sampling_row
+                    adv = jnp.where(upd, adv_next, adv)
+                    feed2 = jnp.where(
+                        upd[:, None],
+                        jnp.stack([f2a, last], axis=1),
+                        feed2,
+                    )
+                    dpos = jnp.where(upd, pos + 1 - adv_next, dpos)
+                    # Commit DFA states for rows continuing past the
+                    # round (alive greedy rows always kept a + 1, so
+                    # the post-state column at a IS the state after
+                    # the round's last emitted token).
+                    post_a = jnp.take_along_axis(
+                        postm, a[:, None], axis=1
+                    )[:, 0]
+                    cstate = jnp.where(upd & cvec, post_a, cstate)
+                    cstate = crt.advance_state(
+                        crow0, cstate, nxt,
+                        alive & sampling_row & cvec,
+                    )
+                    died = died | died_now
+                    out = (toks, kept, a, greedy, adv_eff, fracm)
+                    return (
+                        (pk, pv, dk, dv, pos, dpos, feed, feed2, adv,
+                         alive, keys, n, cstate, died),
+                        out,
+                    )
+
+                init = (
+                    pk, pv, dk, dv, pos, dpos, feed, feed2, adv,
+                    active, keys, jnp.zeros_like(budget), cstate,
+                    jnp.zeros_like(cvec),
+                )
+                (
+                    (pk, pv, dk, dv, pos, dpos, feed, feed2, adv,
+                     alive, keys, n, cstate, died),
+                    (toks_a, kept_a, a_a, greedy_a, advu_a, fracs_a),
+                ) = lax.scan(body, init, None, length=W)
+                return (
+                    pk, pv, dk, dv, feed, feed2, adv, alive, keys,
+                    toks_a, kept_a, a_a, greedy_a, advu_a, cstate,
+                    died, fracs_a,
+                )
+
+            if self.mesh is None:
+                return jax.jit(window, donate_argnums=(1, 2, 3, 4))
+            from jax.sharding import PartitionSpec as PSpec
+
+            from defer_tpu.utils.compat import shard_map
+
+            pool, r = self._pool_specs, PSpec()
+            sm = shard_map(
+                window,
+                self.mesh,
+                in_specs=(self._sdec._specs(), pool, pool)
+                + (r,) * 22,
+                out_specs=(pool, pool) + (r,) * 15,
+                check_rep=False,
+            )
+            return jax.jit(sm, donate_argnums=(1, 2, 3, 4))
+
+        return cached_step(
+            self.dec,
+            ("paged_spec_window_c", self.bs, self.attention,
              self.kv_dtype, W, k, mode, eos, draft.dec.cfg,
              str(draft.dec.compute_dtype), self._mesh_key),
             build,
@@ -2698,7 +3114,8 @@ class PagedDecodeServer:
         return hits
 
     def _admit_radix(
-        self, i, rid, prompt, steps, adapter_id, samp, stop_seqs
+        self, i, rid, prompt, steps, adapter_id, samp, stop_seqs,
+        cid=0,
     ) -> bool:
         """Admission through the PrefixBlockCache: walk leading full
         prompt blocks for hits (refcount++), allocate the rest
@@ -2807,8 +3224,8 @@ class PagedDecodeServer:
         owned = [int(table_row[j]) for j in range(n_full, total)]
         self.prefill_tokens_saved += suffix_pos
         self.blocks_peak = max(self.blocks_peak, self.blocks_in_use)
-        first = self._sampler.admit_first(
-            i, samp, logits_row, prompt.dtype
+        first = self._first_token(
+            i, samp, logits_row, prompt.dtype, cid
         )
         self.tables[i] = table_row
         self.pos[i] = t0
@@ -2822,6 +3239,7 @@ class PagedDecodeServer:
             "shared": shared,
             "sampling": samp is not None,
             "stop": matcher_or_none(stop_seqs),
+            "cid": cid,
         }
         self.slots[i] = slot
         if self._draft is not None and not slot["sampling"]:
@@ -2960,8 +3378,9 @@ class PagedDecodeServer:
             self.blocks_peak = max(
                 self.blocks_peak, self.blocks_in_use + need
             )
-        first = self._sampler.admit_first(
-            i, samp, jnp.asarray(first_logits), jnp.int32
+        first = self._first_token(
+            i, samp, jnp.asarray(first_logits), jnp.int32,
+            entry.get("cid", 0),
         )
         self.tables[i] = table_row
         self.pos[i] = t0
@@ -2974,6 +3393,7 @@ class PagedDecodeServer:
             "blocks": owned,
             "sampling": samp is not None,
             "stop": matcher_or_none(entry["stop"]),
+            "cid": entry.get("cid", 0),
         }
         if shared is not None:
             slot["shared"] = shared
@@ -3000,6 +3420,76 @@ class PagedDecodeServer:
             i, slot, int(first[0, 0]) if need_host else None
         )
         return True
+
+    def _first_token(self, i, samp, lrow, dtype, cid):
+        """Admission's first generated token (the flat server's twin):
+        constrained slots mask the prefill logits row with their DFA's
+        START-state row before the shared argmax/first-draw, then
+        install the advanced state (a device scalar — admission stays
+        sync-free beyond its existing bookkeeping)."""
+        if cid:
+            row = self._ctrans[cid, 0]
+            mask = (row >= 0).at[self.eos_id].set(self._cacc[cid, 0])
+            lrow = jnp.where(
+                mask[None, :], lrow, jnp.finfo(lrow.dtype).min
+            )
+        first = self._sampler.admit_first(i, samp, lrow, dtype)
+        if cid:
+            state = jnp.maximum(row[first[0, 0].astype(jnp.int32)], 0)
+            self._sampler.admit_constraint(i, cid, state)
+            frac = crt.masked_frac(mask[None, :], jnp.asarray([True]))
+            self.obs.constrain_masked_frac.observe(float(frac[0]))
+            self.obs.constrained_tokens.inc()
+            self.constrained_tokens_n += 1
+        return first
+
+    def _constrained_preds(self, logits, props, k):
+        """Target-side constrained greedy walk for one speculative
+        round: position j's pred is the masked argmax at state s_j,
+        where s_{j+1} = trans[s_j, props_j] follows the PROPOSAL
+        chain — exactly the states the committed stream would visit
+        if the proposals are accepted, so the accept test truncates
+        at the first proposal the target's mask rejects and the
+        output stays token-identical to the spec_k=0 constrained
+        chain. Dead states force pred to -1 (out of vocab): never
+        accepted, and the host drain drops the correction with a
+        per-request error. All device jnp (gathers per position) —
+        no host DFA lookups; runs eagerly alongside the eager argmax
+        it replaces. Returns (preds [B,k+1], crow0, cmask0,
+        post_states [B,k+1] = state AFTER committing pred_j,
+        dead [B,k+1], fracs [B,k+1])."""
+        sm = self._sampler
+        cvec = jnp.asarray(sm.row_constrained)
+        s = sm.cstate
+        preds, posts, deads, fracs = [], [], [], []
+        crow0 = cmask0 = None
+        for j in range(k + 1):
+            crow, acc = crt.constrain_rows(
+                self._ctrans, self._cacc, sm.cid, s
+            )
+            cmask = crt.constrain_mask(crow, acc, self.eos_id)
+            if j == 0:
+                crow0, cmask0 = crow, cmask
+            dead_j = cvec & ~cmask.any(-1)
+            p = jnp.argmax(
+                crt.fold_mask(logits[:, j, :], cmask), axis=-1
+            ).astype(jnp.int32)
+            p = jnp.where(dead_j, -1, p)
+            preds.append(p)
+            posts.append(
+                crt.advance_state(
+                    crow, s, jnp.maximum(p, 0), cvec & ~dead_j
+                )
+            )
+            deads.append(dead_j)
+            fracs.append(crt.masked_frac(cmask, cvec))
+            if j < k:
+                s = crt.advance_state(crow, s, props[:, j], cvec)
+        return (
+            jnp.stack(preds, 1), crow0, cmask0,
+            jnp.stack(posts, 1), jnp.stack(deads, 1),
+            jnp.stack(fracs, 1),
+        )
 
     def _admit_prefilled_ready(self, i: int) -> bool | None:
         """Try to seat the oldest DELIVERED prefilled request in slot
@@ -3032,10 +3522,11 @@ class PagedDecodeServer:
             if not self.pending:
                 continue
             (rid, prompt, steps, adapter_id, samp,
-             stop_seqs) = self.pending[0]
+             stop_seqs, cid) = self.pending[0]
             if self.radix is not None:
                 if not self._admit_radix(
-                    i, rid, prompt, steps, adapter_id, samp, stop_seqs
+                    i, rid, prompt, steps, adapter_id, samp, stop_seqs,
+                    cid,
                 ):
                     return  # pool exhausted even after eviction
                 self.pending.pop(0)
@@ -3115,8 +3606,8 @@ class PagedDecodeServer:
                     jnp.asarray(table_row),
                 )
                 logits_row = logits[:, t0 - 1, :]
-            first = self._sampler.admit_first(
-                i, samp, logits_row, prompt.dtype
+            first = self._first_token(
+                i, samp, logits_row, prompt.dtype, cid
             )
             self.tables[i] = table_row
             self.pos[i] = P + t0
@@ -3129,6 +3620,7 @@ class PagedDecodeServer:
                 "blocks": blocks,
                 "sampling": samp is not None,
                 "stop": matcher_or_none(stop_seqs),
+                "cid": cid,
             }
             self.slots[i] = slot
             if self._draft is not None and not slot["sampling"]:
@@ -3223,10 +3715,34 @@ class PagedDecodeServer:
             )
             rows_read = int(np.sum(posm // self.bs - lo + 1)) * self.bs
         self._account_kv_rows(rows_read, baseline)
+        ll = logits[:, -1, :]
+        sm = self._sampler
+        # Constrained rows (defer_tpu/constrain/): fold the DFA mask
+        # into the batched logits BEFORE argmax/draw, advance states
+        # after. Guarded by the host mirror so unconstrained serving
+        # dispatches the exact pre-constraint op sequence.
+        constrained = any(sm.row_constrained)
+        if constrained:
+            crow, cacc = crt.constrain_rows(
+                self._ctrans, self._cacc, sm.cid, sm.cstate
+            )
+            cmask = crt.constrain_mask(crow, cacc, self.eos_id)
+            cvec = jnp.asarray(sm.row_constrained)
+            # Dead end (hand-built DFAs only — dfa.py prunes): no
+            # admissible token. Force eos so the row freezes; the
+            # drain drops the forced token and surfaces the error.
+            dead = cvec & jnp.asarray(live) & ~cmask.any(-1)
+            ll = crt.fold_mask(ll, cmask)
         if any(s is not None and s["sampling"] for s in self.slots):
-            nxt = self._sampler.draw(logits[:, -1, :])
+            nxt = self._sampler.draw(ll)
         else:
-            nxt = jnp.argmax(logits[:, -1, :], axis=-1)
+            nxt = jnp.argmax(ll, axis=-1)
+        if constrained:
+            nxt = jnp.where(dead, self.eos_id, nxt)
+            sm.cstate = crt.advance_state(
+                crow, sm.cstate, nxt, cvec & ~dead
+            )
+            mfrac = crt.masked_frac(cmask, cvec & jnp.asarray(live))
         self._feed = nxt[:, None].astype(jnp.int32)
         # Host transfer only when eos/streaming/stop matching needs
         # the values — the plain path stays async (same guard as the
@@ -3244,9 +3760,36 @@ class PagedDecodeServer:
         # when an eos/stop/stream consumer needs host tokens — the
         # sync this serving loop is designed around
         host_nxt = np.asarray(nxt) if need_host else None
+        if constrained:
+            # analysis: ignore[host-sync-in-hot-loop] one batched
+            # per-tick transfer of the dead-end flags + mask
+            # fractions, and only while a constrained row is live
+            dead_host = np.asarray(dead)
+            # analysis: ignore[host-sync-in-hot-loop] ready with the
+            # vector above (same sync point)
+            mfrac_host = np.asarray(mfrac)
         for i, slot in enumerate(self.slots):
             if slot is None:
                 continue
+            if constrained and slot["cid"]:
+                if bool(dead_host[i]):
+                    # The forced eos never enters the output: the
+                    # request ends at its last admissible token with
+                    # a per-request error, not a hang.
+                    self.errors[slot["rid"]] = (
+                        "constraint dead end: DFA state admits no "
+                        "token and is not accepting"
+                    )
+                    self.constraint_dead_ends_n += 1
+                    self.obs.constrain_dead_ends.inc()
+                    slot["remaining"] = 0
+                    self._finish(i)
+                    continue
+                self.constrained_tokens_n += 1
+                self.obs.constrained_tokens.inc()
+                self.obs.constrain_masked_frac.observe(
+                    float(mfrac_host[i])
+                )
             tok = nxt[i][None, None].astype(slot["last"].dtype)
             slot["last"] = tok
             slot["toks"].append(tok)
@@ -3306,7 +3849,19 @@ class PagedDecodeServer:
             feed2[i, 0] = pend[0]
             feed2[i, 1] = pend[-1]  # len-1 pend feeds its token twice
             dposm[i] = self.pos[i] + 1 - len(pend)
-        props = self._draft.propose(k, dposm, feed2, adv)  # [B, k]
+        sm = self._sampler
+        constrained = any(sm.row_constrained)
+        if constrained:
+            # Lane-side masking: the draft's proposal chain walks the
+            # slot's DFA from its committed state, so candidates stay
+            # grammar-valid (acceptance, not correctness — the
+            # target-side masked preds below are the contract).
+            props = self._draft.propose_c(
+                k, dposm, feed2, adv, self.eos_id,
+                sm.cid, sm.cstate, self._ctrans, self._cacc,
+            )  # [B, k]
+        else:
+            props = self._draft.propose(k, dposm, feed2, adv)  # [B, k]
         # Verify all k+1 positions in ONE block-table forward: row 0
         # re-derives each slot's next token from its feed (the greedy
         # correctness anchor), rows 1..k check the proposals.
@@ -3332,13 +3887,30 @@ class PagedDecodeServer:
             jnp.zeros((self.B,), jnp.int32),
             jnp.asarray(self.adapter.copy()),
         )
-        preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if constrained:
+            # Target-side constrained preds: a device state walk along
+            # the proposal prefix (pred_j = masked argmax at s_j,
+            # s_{j+1} = trans[s_j, props_j]), so the accept rule below
+            # truncates at the first proposal the TARGET's mask
+            # rejects — constrained greedy output is the spec_k=0
+            # constrained chain, token for token. Dead states force
+            # pred_j to -1 (out of vocab): never accepted, and the
+            # correction token is dropped host-side with the error.
+            (preds, crow0, cmask0, post_states, dead_all,
+             fracs) = self._constrained_preds(logits, props, k)
+        else:
+            preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         any_sampling = any(
             s is not None and s["sampling"] for s in self.slots
         )
-        draw = (
-            self._sampler.draw(logits[:, 0, :]) if any_sampling else None
-        )
+        draw = None
+        if any_sampling:
+            ll0 = logits[:, 0, :]
+            if constrained:
+                # Sampled constrained rows draw from the masked row;
+                # free rows' fold is an exact no-op (cid-0 mask).
+                ll0 = crt.fold_mask(ll0, cmask0)
+            draw = self._sampler.draw(ll0)
         self.ticks += 1
         self.dispatches += 2
         n_live = sum(live)
@@ -3385,6 +3957,14 @@ class PagedDecodeServer:
             # analysis: ignore[host-sync-in-hot-loop] sampled rows'
             # slice of the same per-round sync point
             draw_host = np.asarray(draw)
+        if constrained:
+            # analysis: ignore[host-sync-in-hot-loop] dead-end flags +
+            # mask fractions ride the same batched round transfer,
+            # only while a constrained row is live
+            dead_host = np.asarray(dead_all)
+            # analysis: ignore[host-sync-in-hot-loop] same per-round
+            # sync point (ready with the matrix above)
+            fracs_host = np.asarray(fracs)
         a_vec = accept_lengths(props_host, preds_host[:, :k])
         proposed = 0
         accepted_draft = 0
@@ -3396,8 +3976,11 @@ class PagedDecodeServer:
         for i, slot in enumerate(self.slots):
             if slot is None:
                 continue
+            dead_i = False
             if slot["sampling"]:
                 emitted = [int(draw_host[i])]
+                if constrained and slot["cid"] and dead_host[i][0]:
+                    dead_i, emitted = True, []
             else:
                 # analysis: ignore[host-sync-in-hot-loop] a_vec is
                 # host numpy (accept_lengths of the batched fetch)
@@ -3410,6 +3993,13 @@ class PagedDecodeServer:
                 self.obs.spec_acceptance.observe(a)
                 emitted = [int(t) for t in props_host[i, :a]]
                 emitted.append(int(preds_host[i, a]))
+                if constrained and slot["cid"] and dead_host[i][a]:
+                    # The correction position hit a dead DFA state:
+                    # its pred is the -1 sentinel, dropped here, so
+                    # the stream ends at the still-valid accepted
+                    # prefix with a per-request error, not a hang.
+                    dead_i = True
+                    emitted = emitted[:-1]
             # Per-token drain, K=1-equivalent: budget, then eos, then
             # stop — the first terminator wins and everything after it
             # is discarded (a truncated slot always finishes, so the
@@ -3432,6 +4022,24 @@ class PagedDecodeServer:
             slot["remaining"] -= kept
             if stopped:
                 slot["remaining"] = 0
+            if dead_i and kept == len(emitted) and not stopped:
+                # Dead end actually reached (not pre-empted by a
+                # budget cut or stop hit inside the kept prefix).
+                slot["remaining"] = 0
+                self.errors[slot["rid"]] = (
+                    "constraint dead end: DFA state admits no token "
+                    "and is not accepting"
+                )
+                self.constraint_dead_ends_n += 1
+                self.obs.constrain_dead_ends.inc()
+            if constrained and slot["cid"]:
+                self.constrained_tokens_n += kept
+                if kept:
+                    self.obs.constrained_tokens.inc(kept)
+                for j in range(kept):
+                    self.obs.constrain_masked_frac.observe(
+                        float(fracs_host[i][j])
+                    )
             # analysis: ignore[host-sync-in-hot-loop] emitted is a
             # host int list — this UPLOADS the kept tokens, no fetch
             kept_arr = np.asarray(emitted[:kept], np.int32)[None, :]
@@ -3446,7 +4054,7 @@ class PagedDecodeServer:
             finishing[i] = slot["remaining"] == 0
             self.obs.tokens_generated.inc(kept)
             self.window_tokens += kept
-            feedv[i] = emitted[-1]
+            feedv[i] = emitted[-1] if emitted else 0
             if not slot["sampling"] and not finishing[i]:
                 # kept == a + 1 here (truncation implies finish):
                 # partial accept leaves only the correction token
@@ -3461,6 +4069,35 @@ class PagedDecodeServer:
                 self._draft.pos[i] = (
                     self.pos[i] + 1 - len(slot["pend"])
                 )
+        if constrained:
+            # Commit DFA states for rows continuing past the round —
+            # greedy rows select the post-state column at their accept
+            # length (the state after the round's LAST emitted token),
+            # sampled rows advance one step by their draw. Pure UPLOAD
+            # + device gather; finishing rows keep their state and are
+            # reset by release below.
+            sel = np.zeros((self.B,), np.int32)
+            use_post = np.zeros((self.B,), bool)
+            use_draw = np.zeros((self.B,), bool)
+            for i, slot in enumerate(self.slots):
+                if slot is None or not slot["cid"] or finishing[i]:
+                    continue
+                if slot["sampling"]:
+                    use_draw[i] = True
+                else:
+                    use_post[i] = True
+                    # analysis: ignore[host-sync-in-hot-loop] a_vec is
+                    # host numpy (accept_lengths of the batched fetch)
+                    sel[i] = int(a_vec[i])
+            new_c = jnp.take_along_axis(
+                post_states, jnp.asarray(sel)[:, None], 1
+            )[:, 0]
+            cst = jnp.where(jnp.asarray(use_post), new_c, sm.cstate)
+            if draw is not None:
+                cst = crt.advance_state(
+                    crow0, cst, draw, jnp.asarray(use_draw)
+                )
+            sm.cstate = cst
         self._feed = jnp.asarray(feedv[:, None])
         self.spec_rounds_n += 1
         self.spec_proposed_n += proposed
@@ -3510,7 +4147,12 @@ class PagedDecodeServer:
             mode = "sort"
         else:
             mode = "nosort"
-        prog = self._build_spec_window(mode)
+        constrained = any(self._sampler.row_constrained)
+        prog = (
+            self._build_spec_window_c(mode)
+            if constrained
+            else self._build_spec_window(mode)
+        )
         # Round-0 seeds from host truth, exactly _tick_spec's: pend =
         # committed-but-unconsumed draft tokens, lane write head
         # pos + 1 - len(pend).
@@ -3534,8 +4176,7 @@ class PagedDecodeServer:
         # Same aliasing-copy rule as every tick: tables/adapter are
         # host-mutated by finish/admission while the dispatched window
         # may still be reading them.
-        (self.pool_k, self.pool_v, dk, dv, feed, feed2_o, adv_o,
-         alive, keys, toks_a, kept_a, a_a, greedy_a, advu_a) = prog(
+        operands = (
             self.params, self.pool_k, self.pool_v,
             self._draft.ck, self._draft.cv, self._draft.params,
             jnp.asarray(self.tables.copy()), jnp.asarray(posm),
@@ -3545,6 +4186,18 @@ class PagedDecodeServer:
             sm.topp, sm.minp, jnp.asarray(budget, jnp.int32),
             jnp.asarray(self.adapter.copy()),
         )
+        died = fracs_a = None
+        if constrained:
+            (self.pool_k, self.pool_v, dk, dv, feed, feed2_o, adv_o,
+             alive, keys, toks_a, kept_a, a_a, greedy_a, advu_a,
+             cstate, died, fracs_a) = prog(
+                *operands, sm.cid, sm.cstate, self._ctrans, self._cacc,
+            )
+            sm.cstate = cstate
+        else:
+            (self.pool_k, self.pool_v, dk, dv, feed, feed2_o, adv_o,
+             alive, keys, toks_a, kept_a, a_a, greedy_a,
+             advu_a) = prog(*operands)
         self._draft.ck, self._draft.cv = dk, dv
         self._feed = feed
         sm.keys = keys
@@ -3589,6 +4242,13 @@ class PagedDecodeServer:
         # analysis: ignore[host-sync-in-hot-loop] pend recurrence
         # advance, same batched sync point
         adv_h = np.asarray(adv_o)
+        if constrained:
+            # analysis: ignore[host-sync-in-hot-loop] dead-end flags,
+            # same batched per-window sync point
+            died_h = np.asarray(died)
+            # analysis: ignore[host-sync-in-hot-loop] masked-fraction
+            # buffer for obs, same batched per-window sync point
+            fracs_h = np.asarray(fracs_a)
         # Verify-read accounting: the per-round mirror of _tick_spec's
         # (active rows read to pos_r + k; frozen rows sit at trash
         # position 0). Pure host python over the fetched counts.
@@ -3666,6 +4326,38 @@ class PagedDecodeServer:
                 stream_toks[i][r] = row
             if ran:
                 rounds_run += 1
+        for i, slot in enumerate(self.slots):
+            if slot is None or not constrained:
+                continue
+            if slot["cid"] and died_h[i] and not finishing[i]:
+                # Dead-end DFA state mid-window: the device froze the
+                # row with a forced eos — the slot's LAST kept token
+                # (a stop cut would have discarded it as overshoot,
+                # hence the finishing guard). Drop it so the output
+                # ends at the last admissible token and the failure
+                # surfaces as a per-request error, not a hang.
+                for r in range(W - 1, -1, -1):
+                    if kept_rounds[r][i]:
+                        kept_rounds[r][i] -= 1
+                        stream_toks[i][r].pop()
+                        total[i] -= 1
+                        break
+                self.errors[slot["rid"]] = (
+                    "constraint dead end: DFA state admits no token "
+                    "and is not accepting"
+                )
+                self.constraint_dead_ends_n += 1
+                self.obs.constrain_dead_ends.inc()
+            if slot["cid"]:
+                for r in range(W):
+                    kr = kept_rounds[r][i]
+                    self.constrained_tokens_n += kr
+                    if kr:
+                        self.obs.constrained_tokens.inc(kr)
+                    for j in range(kr):
+                        self.obs.constrain_masked_frac.observe(
+                            float(fracs_h[r][i][j])
+                        )
         for i, slot in enumerate(self.slots):
             if slot is None:
                 continue
@@ -3762,25 +4454,41 @@ class PagedDecodeServer:
             mode = "sort"
         else:
             mode = "nosort"
-        window = self._build_window(mode)
         budget = [
             s["remaining"] if s is not None else 0
             for s in self.slots
         ]
         posm = np.where(live, self.pos, 0).astype(np.int32)
         sm = self._sampler
+        constrained = any(sm.row_constrained)
+        died = fracs = None
         # Same aliasing-copy rule as the K=1 tick: tables/adapter are
         # mutated by the host (finish/admission) while the dispatched
         # window may still be reading them.
-        (self.pool_k, self.pool_v, feed, alive, keys, n_dev,
-         toks) = window(
-            self.params, self.pool_k, self.pool_v,
-            jnp.asarray(self.tables.copy()), jnp.asarray(posm),
-            self._feed, jnp.asarray(live), sm.keys, sm.temp,
-            sm.topk, sm.topp, sm.minp,
-            jnp.asarray(budget, jnp.int32),
-            jnp.asarray(self.adapter.copy()),
-        )
+        if constrained:
+            window = self._build_window_c(mode)
+            (self.pool_k, self.pool_v, feed, alive, keys, n_dev,
+             toks, cstate, died, fracs) = window(
+                self.params, self.pool_k, self.pool_v,
+                jnp.asarray(self.tables.copy()), jnp.asarray(posm),
+                self._feed, jnp.asarray(live), sm.keys, sm.temp,
+                sm.topk, sm.topp, sm.minp,
+                jnp.asarray(budget, jnp.int32),
+                jnp.asarray(self.adapter.copy()),
+                sm.cid, sm.cstate, self._ctrans, self._cacc,
+            )
+            sm.cstate = cstate
+        else:
+            window = self._build_window(mode)
+            (self.pool_k, self.pool_v, feed, alive, keys, n_dev,
+             toks) = window(
+                self.params, self.pool_k, self.pool_v,
+                jnp.asarray(self.tables.copy()), jnp.asarray(posm),
+                self._feed, jnp.asarray(live), sm.keys, sm.temp,
+                sm.topk, sm.topp, sm.minp,
+                jnp.asarray(budget, jnp.int32),
+                jnp.asarray(self.adapter.copy()),
+            )
         self._feed = feed
         sm.keys = keys
         self.ticks += 1
@@ -3816,9 +4524,18 @@ class PagedDecodeServer:
         # [B, K] token transfer per window that replaces K per-tick
         # [B, 1] transfers — only when a stream/stop consumer exists
         toks_host = np.asarray(toks).tolist() if need_toks else None
+        died_host = fracs_host = None
+        if constrained:
+            # analysis: ignore[host-sync-in-hot-loop] rides the same
+            # per-window sync: batched dead-end flags + [B, K] mask
+            # fractions, only while a constrained row is live
+            died_host = np.asarray(died).tolist()
+            # analysis: ignore[host-sync-in-hot-loop] same per-window
+            # sync point (ready with the vector above)
+            fracs_host = np.asarray(fracs)
         self._account_kv_rows_window(posm, emitted)
         self._drain_window(toks, toks_host, emitted, alive_host,
-                           budget)
+                           budget, died_host, fracs_host)
 
     def _account_kv_rows_window(self, posm, emitted) -> None:
         """Windowed K/V-row accounting: the exact host-side mirror of
@@ -3858,7 +4575,8 @@ class PagedDecodeServer:
         self._account_kv_rows(rows_read, baseline)
 
     def _drain_window(
-        self, toks, toks_host, emitted, alive_host, budget
+        self, toks, toks_host, emitted, alive_host, budget,
+        died_host=None, fracs_host=None,
     ) -> None:
         """Host-side window drain, per-token-equivalent to the K=1
         tick loop (flat-server _drain_window docstring has the
@@ -3875,8 +4593,18 @@ class PagedDecodeServer:
             n_i = emitted[i]
             a_i = n_i
             stopped = False
+            dead = bool(
+                died_host is not None and died_host[i]
+                and slot.get("cid")
+            )
+            if dead:
+                # Dead-end DFA state mid-window: the device froze the
+                # row with a FORCED eos (counted in n_i) — drop it, so
+                # the output ends at the last admissible token and the
+                # failure surfaces as a per-request error, not a hang.
+                a_i = n_i - 1
             if slot["stop"] is not None:
-                hit = slot["stop"].push_window(toks_host[i][:n_i])
+                hit = slot["stop"].push_window(toks_host[i][:a_i])
                 if hit is not None:
                     a_i, stopped = hit, True
             accepted[i] = a_i
@@ -3887,6 +4615,20 @@ class PagedDecodeServer:
                 # eos froze the row on device, a stop sequence cut it
                 # on drain, or its budget ran out mid-window.
                 slot["remaining"] = 0
+            if dead:
+                slot["remaining"] = 0
+                self.errors[slot["rid"]] = (
+                    "constraint dead end: DFA state admits no token "
+                    "and is not accepting"
+                )
+                self.constraint_dead_ends_n += 1
+                self.obs.constrain_dead_ends.inc()
+            if slot.get("cid") and fracs_host is not None:
+                self.constrained_tokens_n += a_i
+                if a_i:
+                    self.obs.constrained_tokens.inc(a_i)
+                for fr in fracs_host[i][:a_i].tolist():
+                    self.obs.constrain_masked_frac.observe(fr)
             tok_block = toks[i, :a_i][None, :].astype(
                 slot["last"].dtype
             )
@@ -3985,6 +4727,7 @@ def serve_paged(
     prefill_chunk: int | None = None,
     mesh: Any = None,
     model_axis: str = "model",
+    constraints: dict | None = None,
 ) -> tuple[list[jax.Array], dict]:
     """One-shot paged serving; returns (outputs in submission order,
     stats incl. peak pool usage). `adapter_ids` optionally assigns a
@@ -4017,7 +4760,12 @@ def serve_paged(
     docstring: half the HBM bytes, bounded-logit-error accuracy
     contract); `spill_bytes=N` adds the host-RAM spill tier for
     evicted prefix blocks (needs prefix_cache=True). Stats carry
-    `kv_dtype`, `pool_bytes` and the spill totals either way."""
+    `kv_dtype`, `pool_bytes` and the spill totals either way.
+
+    `constraints={name: TokenDFA}` registers compiled grammars
+    (defer_tpu/constrain/) that per-request SamplingParams can opt
+    into via `constraint="name"`; stats then also carry
+    `constrained_tokens` / `constraint_dead_ends`."""
     srv = PagedDecodeServer(
         dec,
         params,
@@ -4037,6 +4785,7 @@ def serve_paged(
         prefill_chunk=prefill_chunk,
         mesh=mesh,
         model_axis=model_axis,
+        constraints=constraints,
     )
     aids = adapter_ids or [0] * len(requests)
     if len(aids) != len(requests):
@@ -4099,5 +4848,7 @@ def serve_paged(
         spill_stored_bytes=(
             srv._spill.stored_bytes if srv._spill is not None else 0
         ),
+        constrained_tokens=srv.constrained_tokens_n,
+        constraint_dead_ends=srv.constraint_dead_ends_n,
     )
     return [done[r] for r in rids], stats

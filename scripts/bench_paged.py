@@ -37,7 +37,14 @@ bench.py runs it as the "tp_serving" extras section. And
 KV-quantization sweep (kv_dtype fp vs int8 over the same
 over-subscribed Zipf prefix mix with the host-RAM spill tier on)
 pricing tokens/sec, resident-requests-per-pool-MiB and the spill
-revival rate; bench.py runs it as the "kv_quant" extras section.
+revival rate; bench.py runs it as the "kv_quant" extras section. And
+`run_constrain_sweep(devices) -> dict` (`--constrain-sweep`) — the
+constrained-decoding sweep (defer_tpu/constrain/: the same request
+mix served free vs regex-constrained vs JSON-schema-constrained)
+pricing the on-device DFA mask fold (tokens/sec vs the free
+baseline), host compile time, DFA table size and the mean
+masked-vocabulary fraction; bench.py runs it as the "constrain"
+extras section.
 
 "pallas" is excluded by default off-TPU: the interpret-mode kernel is
 functionally identical but interpreter-slow, which would price the
@@ -796,6 +803,200 @@ def run_kv_quant_sweep(
     return out
 
 
+def run_constrain_sweep(
+    devices=None,
+    *,
+    modes: tuple = ("free", "regex", "json"),
+    decode_window: int = 1,
+    num_layers: int = 2,
+    dim: int = 64,
+    num_heads: int = 4,
+    num_kv_heads: int = 2,
+    vocab_size: int = 128,
+    max_len: int = 256,
+    num_blocks: int = 33,
+    block_size: int = 4,
+    max_batch: int = 4,
+    num_requests: int = 8,
+) -> dict:
+    """Constrained-decoding sweep (defer_tpu/constrain/): the same
+    request mix served three ways — free (constraints registered but
+    no request opts in: the pre-constraint programs must dispatch),
+    regex-constrained (`[0-9]+(\\.[0-9]+)?`), and JSON-schema-
+    constrained (an object with a boolean and a bounded integer
+    array) — each at `decode_window` sub-steps per host dispatch.
+    Returns {config, constraints: {mode: {tokens_per_sec,
+    tps_vs_free, constrained_tokens, mean_masked_frac, dead_ends,
+    compile_ms, dfa_states, dfa_table_kib}}}.
+
+    Two prices being measured: (1) the host compiler — regex ->
+    char DFA -> token lift -> dead-state prune, a one-off cost per
+    (pattern, vocab) reported in compile_ms with the resulting
+    stacked-table footprint (dfa_states, dfa_table_kib); (2) the
+    device mask fold — one [B] gather + where + argmax riding the
+    existing tick, so tps_vs_free near 1.0 is the acceptance bar
+    (off-TPU the gap prices dispatch, not bandwidth). The vocabulary
+    is synthetic char-level text (digits, letters, JSON punctuation,
+    a few multi-char merges exercising the token lift), sized to the
+    model's `vocab_size`; mean_masked_frac says how much of that
+    vocabulary the grammar removed per emitted token — near 1.0
+    means the DFA, not the model, is doing the choosing."""
+    import jax
+    import jax.numpy as jnp
+
+    from defer_tpu import obs
+    from defer_tpu.constrain import compile_json_schema, compile_regex
+    from defer_tpu.models.gpt import GptDecoder, SamplingParams
+    from defer_tpu.models.llama import llama_config
+    from defer_tpu.runtime.paged import serve_paged
+
+    # Char-level vocabulary: id 0 is the empty string and doubles as
+    # eos; then chars the constraints below can spell, a few
+    # multi-char merges (the token-lift cases), filler to size.
+    chars = list(
+        "0123456789abcdefghijklmnopqrstuvwxyz"
+        "{}[]\",:.- eE+ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+    )
+    vocab = [""] + chars + ["ab", "12", '":', "},", "true", "false"]
+    if len(vocab) > vocab_size:
+        raise ValueError(
+            f"vocab_size {vocab_size} too small for the "
+            f"{len(vocab)}-token constraint vocabulary"
+        )
+    vocab += [f"<u{i}>" for i in range(vocab_size - len(vocab))]
+
+    pattern = r"[0-9]+(\.[0-9]+)?"
+    schema = {
+        "type": "object",
+        "properties": {
+            "ok": {"type": "boolean"},
+            "ids": {
+                "type": "array",
+                "items": {"type": "integer"},
+                "minItems": 1,
+                "maxItems": 3,
+            },
+        },
+    }
+    compiled = {}
+    for name, build in (
+        ("regex", lambda: compile_regex(pattern, vocab)),
+        ("json", lambda: compile_json_schema(schema, vocab)),
+    ):
+        t0 = time.perf_counter()
+        dfa = build()
+        compiled[name] = (dfa, (time.perf_counter() - t0) * 1e3)
+    constraints = {n: d for n, (d, _) in compiled.items()}
+
+    cfg = llama_config(
+        num_layers=num_layers,
+        dim=dim,
+        num_heads=num_heads,
+        num_kv_heads=num_kv_heads,
+        ffn_dim=dim * 2,
+        vocab_size=vocab_size,
+        max_len=max_len,
+    )
+    dec = GptDecoder(cfg, compute_dtype=jnp.bfloat16)
+    params = dec.cast_params(dec.init(jax.random.key(0)))
+    if devices:
+        params = jax.device_put(params, devices[0])
+    reqs = []
+    for i in range(num_requests):
+        t0 = 4 + (i * 5) % 12
+        steps = 16 + (i * 7) % 16
+        prompt = jax.random.randint(
+            jax.random.fold_in(jax.random.key(1), i),
+            (1, t0),
+            1,
+            cfg.vocab_size,
+        )
+        reqs.append((prompt, steps))
+    total_tokens = sum(s for _, s in reqs)
+    out: dict = {
+        "config": {
+            "num_layers": num_layers,
+            "dim": dim,
+            "heads": f"{num_heads}/{num_kv_heads}kv",
+            "vocab_size": vocab_size,
+            "max_len": max_len,
+            "num_blocks": num_blocks,
+            "block_size": block_size,
+            "max_batch": max_batch,
+            "requests": num_requests,
+            "total_tokens": total_tokens,
+            "decode_window": decode_window,
+            "pattern": pattern,
+        },
+        "constraints": {},
+    }
+    reg = obs.get_registry()
+    frac_key = dict(server="paged")
+    free_tps = None
+    for mode in modes:
+        sp = (
+            None
+            if mode == "free"
+            else SamplingParams(constraint=mode)
+        )
+
+        def run():
+            before = reg.value(
+                "defer_constrain_masked_frac", **frac_key
+            ) or {"count": 0, "sum": 0.0}
+            t0 = time.perf_counter()
+            outs, stats = serve_paged(
+                dec,
+                params,
+                reqs,
+                num_blocks=num_blocks,
+                block_size=block_size,
+                max_batch=max_batch,
+                eos_id=0,
+                decode_window=decode_window,
+                constraints=constraints,
+                sampling=[sp] * len(reqs),
+            )
+            jax.block_until_ready(outs[-1])
+            dt = time.perf_counter() - t0
+            after = reg.value(
+                "defer_constrain_masked_frac", **frac_key
+            ) or {"count": 0, "sum": 0.0}
+            dcount = after["count"] - before["count"]
+            dsum = after["sum"] - before["sum"]
+            return dt, stats, (dsum / dcount if dcount else 0.0)
+
+        run()  # compile pass
+        dt, stats, mean_frac = run()
+        # Constrained streams stop at eos when the grammar is
+        # satisfied, so normalize throughput by tokens actually
+        # emitted, not the step budget.
+        emitted = stats["constrained_tokens"] or total_tokens
+        tps = emitted / dt
+        if mode == "free":
+            free_tps = tps
+        rec = {
+            "tokens_per_sec": round(tps, 1),
+            "tps_vs_free": round(
+                tps / free_tps if free_tps else 0.0, 3
+            ),
+            "constrained_tokens": stats["constrained_tokens"],
+            "mean_masked_frac": round(mean_frac, 4),
+            "dead_ends": stats["constraint_dead_ends"],
+        }
+        if mode in compiled:
+            dfa, ms = compiled[mode]
+            rec.update(
+                compile_ms=round(ms, 2),
+                dfa_states=dfa.num_states,
+                dfa_table_kib=round(
+                    dfa.transitions.nbytes / 1024, 1
+                ),
+            )
+        out["constraints"][mode] = rec
+    return out
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(
         description="paged-decode attention microbench (one JSON line)"
@@ -867,6 +1068,26 @@ def main() -> None:
         help="comma-separated kv_dtype values for --kv-quant-sweep",
     )
     ap.add_argument(
+        "--constrain-sweep",
+        action="store_true",
+        help="run the constrained-decoding sweep (the same request "
+        "mix served free vs regex- vs JSON-schema-constrained, "
+        "defer_tpu/constrain/) instead of the attention microbench",
+    )
+    ap.add_argument(
+        "--constrain-modes",
+        default="free,regex,json",
+        help="comma-separated subset of free,regex,json for "
+        "--constrain-sweep",
+    )
+    ap.add_argument(
+        "--constrain-window",
+        type=int,
+        default=1,
+        help="decode_window for --constrain-sweep (W>1 prices the "
+        "constrained fused-window path)",
+    )
+    ap.add_argument(
         "--tp-sweep",
         action="store_true",
         help="run the tensor-parallel serving sweep (model_axis = "
@@ -914,6 +1135,35 @@ def main() -> None:
         }
         dtypes = tuple(d for d in args.kv_dtypes.split(",") if d)
         rec = run_kv_quant_sweep(dtypes=dtypes, **shared)
+    elif args.constrain_sweep:
+        # Same default-dropping as --spec-sweep: the sweep's own tiny
+        # char-vocab model defaults win unless a flag was explicitly
+        # overridden.
+        arg_of = {
+            "num_layers": "layers",
+            "dim": "dim",
+            "num_heads": "heads",
+            "num_kv_heads": "kv_heads",
+            "vocab_size": "vocab",
+            "max_len": "max_len",
+            "num_blocks": "blocks",
+            "block_size": "block_size",
+            "max_batch": "batch",
+            "num_requests": "requests",
+        }
+        shared = {
+            k: v
+            for k, v in shared.items()
+            if v != ap.get_default(arg_of[k])
+        }
+        modes = tuple(
+            m for m in args.constrain_modes.split(",") if m
+        )
+        rec = run_constrain_sweep(
+            modes=modes,
+            decode_window=args.constrain_window,
+            **shared,
+        )
     elif args.tp_sweep:
         # Same default-dropping as --spec-sweep: run_tp_sweep's own
         # model defaults (kv_heads=8 so every axis divides) win unless

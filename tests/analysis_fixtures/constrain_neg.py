@@ -1,0 +1,27 @@
+"""NEGATIVE: the constrained-tick shape the runtime actually ships
+(runtime/paged.py::_tick, constrain/runtime.py) — the DFA gather,
+mask fold and state advance are all device jnp riding the existing
+step, and the only new host traffic is the dead-end flag vector
+folded into the tick's one batched drain, justified in place."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class Server:
+    def _tick(self):
+        crow = self._ctrans[self._sampler.cid, self._sampler.cstate]
+        mask = crow >= 0
+        ll = jnp.where(mask, self._forward(), jnp.finfo(jnp.float32).min)
+        nxt = jnp.argmax(ll, axis=-1)
+        dead = ~mask.any(-1)
+        self._sampler.cstate = jnp.take_along_axis(
+            crow, nxt[:, None], 1
+        )[:, 0]
+        # analysis: ignore[host-sync-in-hot-loop] dead-end flags ride
+        # the tick's one batched drain transfer, only while a
+        # constrained row is live
+        dead_host = np.asarray(dead)
+        for i, slot in enumerate(self.slots):
+            if dead_host[i]:
+                slot.fail("constraint dead end")

@@ -1,0 +1,20 @@
+"""POSITIVE: constrained decoding done WRONG — the host walks the
+DFA itself inside the tick's per-slot loop, pulling each slot's
+device-resident state down as a scalar and fetching its transition
+row to argmax on the host. That is O(B) blocking device->host round
+trips per token, where the shipped runtime folds the mask on device
+(one gather + one where, constrain/runtime.py) and never reads the
+state back."""
+
+import numpy as np
+
+
+class Server:
+    def _tick(self):
+        logits = self._forward()
+        states = self._sampler.cstate  # device-resident rows
+        for i, slot in enumerate(self.slots):
+            s = int(states[i])  # per-slot state pull
+            row = np.asarray(self._ctrans[slot.cid, s])  # row fetch
+            masked = np.where(row >= 0, logits[i], -1e30)
+            slot.emit(masked.argmax())

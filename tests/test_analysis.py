@@ -32,6 +32,7 @@ CASES = [
     ("host-sync-in-hot-loop", "spec_window", 2),
     ("host-sync-in-hot-loop", "shard_map", 2),
     ("host-sync-in-hot-loop", "kv_spill", 2),
+    ("host-sync-in-hot-loop", "constrain", 2),
     ("fresh-closure-jit", "fresh_closure", 2),
     ("prng-key-reuse", "prng_reuse", 1),
     ("lock-discipline", "lock_discipline", 2),

@@ -1,0 +1,460 @@
+"""On-device constrained decoding (defer_tpu/constrain/, ISSUE 17).
+
+Three contracts. (1) COMPILER: `compile_regex` lowers a regex against
+the token-string vocabulary into a dead-end-free TokenDFA (token
+lift: a multi-char token is admissible iff the char DFA accepts its
+whole spelling from the current state), and `schema_to_regex` lowers
+the JSON-schema subset into a pattern that is simultaneously valid
+for dfa.py and Python `re` — so every constrained output below is
+re-validated with `re.fullmatch` (and `json.loads` for schemas).
+(2) PARITY: constrained greedy output is TOKEN-IDENTICAL across
+decode_window {1, 8} x spec_k {0, 4} x attention {gathered,
+blockwise} x tensor parallelism, with free riders in the same batch
+bit-identical to an unconstrained server. (3) FAILURE: a hand-built
+DFA that dead-ends surfaces as a clean per-request error (the forced
+eos never enters the output), never a hang; `constraints=None`
+serving is bit-identical and retrace-free — the subsystem costs
+nothing when off."""
+
+import json
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from defer_tpu.analysis import trace_sanitizer as sanitize
+from defer_tpu.constrain import (
+    ConstraintError,
+    TokenDFA,
+    compile_json_schema,
+    compile_regex,
+    schema_to_regex,
+)
+from defer_tpu.models.gpt import SamplingParams, tiny_gpt
+from defer_tpu.parallel.mesh import make_mesh
+from defer_tpu.runtime.decode_server import DecodeServer, serve_greedy
+from defer_tpu.runtime.paged import PagedDecodeServer, serve_paged
+
+# Synthetic 128-string vocabulary for tiny_gpt (vocab_size 128):
+# id 0 is the empty string and doubles as eos, then single chars,
+# a few multi-char tokens (the token-lift cases), then filler.
+_CHARS = list("0123456789abcdefghijklmnopqrstuvwxyz{}[]\",:.- eE+")
+VOCAB = [""] + _CHARS + ["ab", "12", '":', "},"]
+VOCAB += [f"<u{i}>" for i in range(128 - len(VOCAB))]
+
+DIGITS = "[0-9]+"
+SCHEMA = {"type": "object", "properties": {"ok": {"type": "boolean"}}}
+EOS = 0
+
+
+def detok(ids):
+    """ids -> text; id 0 ("") contributes nothing, so trailing eos
+    and padding vanish without special-casing."""
+    return "".join(VOCAB[int(t)] for t in np.asarray(ids).ravel())
+
+
+def tid(s):
+    return VOCAB.index(s)
+
+
+@pytest.fixture(scope="module")
+def model():
+    dec = tiny_gpt(64)
+    params = dec.init(jax.random.key(0))
+    return dec, params
+
+
+@pytest.fixture(scope="module")
+def cons():
+    return {
+        "digits": compile_regex(DIGITS, VOCAB),
+        "obj": compile_json_schema(SCHEMA, VOCAB),
+    }
+
+
+def _trap():
+    """Hand-built 2-state DFA: state 0 admits exactly one token into
+    a non-accepting trap that admits nothing — the dead-end case
+    compiled DFAs can never produce (prune_dead_states)."""
+    tr = np.full((2, 128), -1, np.int32)
+    tr[0, 5] = 1
+    return TokenDFA(
+        transitions=tr,
+        accepting=np.array([False, False]),
+        pattern="<trap>",
+    )
+
+
+def _requests():
+    rng = np.random.default_rng(11)
+    mk = lambda n: jnp.asarray(
+        rng.integers(1, 128, size=(1, n)), jnp.int32
+    )
+    return [(mk(3), 8), (mk(4), 16), (mk(2), 8)]
+
+
+# -- compiler ----------------------------------------------------------
+
+
+def test_compile_regex_walk_and_admissible():
+    dfa = compile_regex(DIGITS, VOCAB)
+    assert dfa.vocab_size == 128
+    s = dfa.walk([tid("1"), tid("2")])
+    assert s >= 0 and dfa.accepting[s]
+    assert dfa.walk([tid("a")]) == -1
+    # Start state admits exactly the ten digits plus the "12" lift.
+    adm = set(np.flatnonzero(dfa.admissible(dfa.start)).tolist())
+    assert adm == {tid(c) for c in "0123456789"} | {tid("12")}
+
+
+def test_token_lift_multichar_spelling():
+    # "[0-9]" (exactly one digit) must NOT admit the 2-char "12"
+    # token; "12+" must admit it from start (spelling "1","2").
+    one = compile_regex("[0-9]", VOCAB)
+    assert not one.admissible(one.start)[tid("12")]
+    rep = compile_regex("12+", VOCAB)
+    assert rep.admissible(rep.start)[tid("12")]
+    s = rep.step(rep.start, tid("12"))
+    assert s >= 0 and rep.accepting[s]
+
+
+def test_compiled_dfas_are_dead_end_free():
+    for pat in (DIGITS, "(ab|a)c*", schema_to_regex(SCHEMA)):
+        dfa = compile_regex(pat, VOCAB)
+        for s in range(dfa.num_states):
+            assert dfa.accepting[s] or dfa.admissible(s).any(), (
+                pat, s,
+            )
+
+
+def test_unsatisfiable_pattern_raises():
+    with pytest.raises(ConstraintError, match="unsatisfiable"):
+        compile_regex("[0-9]#", VOCAB)  # '#' not in any token
+
+
+def test_schema_regex_is_re_compatible_and_json_valid():
+    pat = schema_to_regex(SCHEMA)
+    for text in ('{"ok":true}', '{"ok":false}'):
+        assert re.fullmatch(pat, text)
+        assert json.loads(text) in ({"ok": True}, {"ok": False})
+    assert not re.fullmatch(pat, '{"ok":1}')
+    enum = schema_to_regex({"enum": ["a", "b"]})
+    assert re.fullmatch(enum, '"a"') and not re.fullmatch(enum, '"c"')
+    arr = schema_to_regex(
+        {"type": "array", "items": {"type": "integer"},
+         "minItems": 1, "maxItems": 2}
+    )
+    assert re.fullmatch(arr, "[1,23]") and not re.fullmatch(arr, "[]")
+    with pytest.raises(ConstraintError, match="unsupported"):
+        schema_to_regex({"type": "tuple"})
+
+
+# -- submit-time validation --------------------------------------------
+
+
+def test_constraints_require_eos(model, cons):
+    dec, params = model
+    with pytest.raises(ValueError, match="eos_id"):
+        PagedDecodeServer(
+            dec, params, num_blocks=12, block_size=4, max_batch=2,
+            constraints=cons,
+        )
+    with pytest.raises(ValueError, match="eos_id"):
+        DecodeServer(dec, params, max_batch=2, constraints=cons)
+
+
+def test_unknown_and_unregistered_constraint_rejected(model, cons):
+    dec, params = model
+    p = jnp.asarray([[3, 9]], jnp.int32)
+    srv = PagedDecodeServer(
+        dec, params, num_blocks=12, block_size=4, max_batch=2,
+        eos_id=EOS, constraints=cons,
+    )
+    with pytest.raises(ValueError, match="unknown constraint"):
+        srv.submit(p, 4, sampling=SamplingParams(constraint="nope"))
+    bare = PagedDecodeServer(
+        dec, params, num_blocks=12, block_size=4, max_batch=2,
+        eos_id=EOS,
+    )
+    with pytest.raises(ValueError, match="without constraints"):
+        bare.submit(p, 4, sampling=SamplingParams(constraint="digits"))
+
+
+def test_dead_start_state_rejected_at_submit(model):
+    dec, params = model
+    tr = np.full((1, 128), -1, np.int32)
+    stuck = TokenDFA(
+        transitions=tr, accepting=np.array([False]), pattern="<stuck>"
+    )
+    srv = PagedDecodeServer(
+        dec, params, num_blocks=12, block_size=4, max_batch=2,
+        eos_id=EOS, constraints={"stuck": stuck},
+    )
+    with pytest.raises(ValueError, match="no first token"):
+        srv.submit(
+            jnp.asarray([[3]], jnp.int32), 4,
+            sampling=SamplingParams(constraint="stuck"),
+        )
+
+
+# -- parity matrix ------------------------------------------------------
+
+
+def _serve(model, cons, *, window=1, spec=0, attention="gathered",
+           mesh=None):
+    dec, params = model
+    reqs = _requests()
+    kw = dict(
+        num_blocks=24, block_size=4, max_batch=4, eos_id=EOS,
+        decode_window=window, attention=attention,
+        constraints=cons,
+        sampling=[
+            SamplingParams(constraint="digits"),
+            SamplingParams(constraint="obj"),
+            None,  # free rider in the same batch
+        ],
+    )
+    if spec:
+        kw.update(spec_draft=dec, spec_params=params, spec_k=spec)
+    if mesh is not None:
+        kw.update(mesh=mesh)
+    return serve_paged(dec, params, list(reqs), **kw), reqs
+
+
+def _validate(outs, reqs):
+    dig = detok(outs[0][0, reqs[0][0].shape[1]:])
+    assert re.fullmatch(DIGITS, dig), dig
+    obj = detok(outs[1][0, reqs[1][0].shape[1]:])
+    assert re.fullmatch(schema_to_regex(SCHEMA), obj), obj
+    assert json.loads(obj) in ({"ok": True}, {"ok": False})
+
+
+@pytest.fixture(scope="module")
+def cref(model, cons):
+    """Reference: window 1, spec 0, gathered, no mesh — validated
+    once; every matrix point must reproduce it token for token."""
+    (outs, stats), reqs = _serve(model, cons)
+    outs = [np.asarray(o) for o in outs]
+    _validate(outs, reqs)
+    assert stats["constrained_tokens"] > 0
+    assert stats["constraint_dead_ends"] == 0
+    return outs
+
+
+@pytest.mark.parametrize("attention", ["gathered", "blockwise"])
+@pytest.mark.parametrize("spec", [0, 4])
+@pytest.mark.parametrize("window", [1, 8])
+def test_constrained_token_identical_matrix(
+    model, cons, cref, window, spec, attention
+):
+    if (window, spec, attention) == (1, 0, "gathered"):
+        pytest.skip("the reference point itself")
+    (outs, stats), reqs = _serve(
+        model, cons, window=window, spec=spec, attention=attention
+    )
+    _validate([np.asarray(o) for o in outs], reqs)
+    for got, want in zip(outs, cref):
+        np.testing.assert_array_equal(np.asarray(got), want)
+    assert stats["constrained_tokens"] > 0
+
+
+@pytest.mark.parametrize("spec", [0, 4])
+def test_constrained_token_identical_tp2(model, cons, cref, spec):
+    mesh = make_mesh({"model": 2}, jax.devices()[:2])
+    (outs, stats), reqs = _serve(
+        model, cons, window=8, spec=spec, mesh=mesh
+    )
+    _validate([np.asarray(o) for o in outs], reqs)
+    for got, want in zip(outs, cref):
+        np.testing.assert_array_equal(np.asarray(got), want)
+    assert stats["mesh_shape"] == "model=2"
+
+
+def test_flat_server_matches_paged(model, cons, cref):
+    """The flat DecodeServer runs the same DFA runtime over its dense
+    cache: same tokens as the paged reference, window 1 and 8."""
+    dec, params = model
+    reqs = _requests()
+    for window in (1, 8):
+        outs, stats = serve_greedy(
+            dec, params, list(reqs), max_batch=4, eos_id=EOS,
+            decode_window=window, constraints=cons,
+            sampling=[
+                SamplingParams(constraint="digits"),
+                SamplingParams(constraint="obj"),
+                None,
+            ],
+        )
+        for got, want in zip(outs, cref):
+            np.testing.assert_array_equal(np.asarray(got), want)
+        assert stats["constrained_tokens"] > 0
+
+
+def test_constrained_sampling_stays_in_grammar(model, cons):
+    """Temperature > 0 composes with the mask: every sampled token is
+    grammar-admissible (the draw sees folded logits), across plain,
+    windowed and speculative serving."""
+    dec, params = model
+    p = jnp.asarray([[7, 21]], jnp.int32)
+    for kw in (
+        {},
+        {"decode_window": 8},
+        {"spec_draft": dec, "spec_params": params, "spec_k": 3},
+    ):
+        outs, _ = serve_paged(
+            dec, params, [(p, 10)], num_blocks=16, block_size=4,
+            max_batch=2, eos_id=EOS, constraints=cons,
+            sampling=[
+                SamplingParams(temperature=0.9, seed=3,
+                               constraint="digits")
+            ],
+            **kw,
+        )
+        text = detok(np.asarray(outs[0])[0, 2:])
+        assert re.fullmatch(DIGITS, text), (kw, text)
+
+
+# -- dead ends and mid-window eos --------------------------------------
+
+
+@pytest.mark.parametrize("spec", [0, 4])
+@pytest.mark.parametrize("window", [1, 8])
+def test_dead_end_is_clean_error_not_hang(model, window, spec):
+    """A hand-built trap DFA: one admissible token, then a state that
+    admits nothing and does not accept. The request finishes with a
+    per-request error, output ends at the last admissible token (the
+    device-forced eos is dropped), and the free rider in the same
+    batch is untouched."""
+    dec, params = model
+    kw = dict(
+        num_blocks=24, block_size=4, max_batch=2, eos_id=EOS,
+        decode_window=window, constraints={"trap": _trap()},
+    )
+    if spec:
+        kw.update(spec_draft=dec, spec_params=params, spec_k=spec)
+    srv = PagedDecodeServer(dec, params, **kw)
+    p = jnp.asarray([[3, 9, 27]], jnp.int32)
+    free_p = jnp.asarray([[5]], jnp.int32)
+    r1 = srv.submit(p, 8, sampling=SamplingParams(constraint="trap"))
+    r2 = srv.submit(free_p, 6)
+    done = srv.run()
+    out = np.asarray(done[r1])[0]
+    assert list(out[3:]) == [5], out
+    assert "dead end" in srv.errors[r1]
+    assert srv.constraint_dead_ends_n == 1
+    np.testing.assert_array_equal(
+        np.asarray(done[r2]),
+        np.asarray(dec.generate(params, free_p, 6)),
+    )
+
+
+def test_mid_window_satisfied_constraint_stops_at_eos(model, cons):
+    """A satisfied schema emits eos (admitted only in accepting
+    states) mid-window: generation must stop there, well short of the
+    step budget, and the tail must not leak."""
+    dec, params = model
+    p = jnp.asarray([[7, 21]], jnp.int32)
+    outs, _ = serve_paged(
+        dec, params, [(p, 40)], num_blocks=24, block_size=4,
+        max_batch=2, eos_id=EOS, decode_window=8, constraints=cons,
+        sampling=[SamplingParams(constraint="obj")],
+    )
+    out = np.asarray(outs[0])[0]
+    text = detok(out[2:])
+    assert json.loads(text) in ({"ok": True}, {"ok": False})
+    # eos fired mid-window: well under the 40-step budget.
+    assert out.shape[0] - 2 < 20
+
+
+# -- release / re-admission (satellite: full policy-row reset) ---------
+
+
+def test_slot_release_resets_all_policy_rows(model, cons):
+    """A slot that served a constrained request, then a heavily
+    filtered sampled request, must serve a plain greedy request
+    EXACTLY like a fresh server — release() clears constraint rows
+    AND every filter row (temp/topk/topp/minp), so nothing leaks
+    into the re-admitted stream."""
+    dec, params = model
+    p3 = jnp.asarray([[4, 8, 15]], jnp.int32)
+    srv = PagedDecodeServer(
+        dec, params, num_blocks=16, block_size=4, max_batch=1,
+        eos_id=EOS, constraints=cons,
+    )
+    srv.submit(
+        jnp.asarray([[3, 9]], jnp.int32), 5,
+        sampling=SamplingParams(constraint="digits"),
+    )
+    srv.run()
+    srv.submit(
+        jnp.asarray([[6]], jnp.int32), 5,
+        sampling=SamplingParams(
+            temperature=0.8, top_k=5, top_p=0.6, min_p=0.2, seed=9
+        ),
+    )
+    srv.run()
+    r3 = srv.submit(p3, 6)
+    got = np.asarray(srv.run()[r3])
+    fresh = PagedDecodeServer(
+        dec, params, num_blocks=16, block_size=4, max_batch=1,
+        eos_id=EOS,
+    )
+    rf = fresh.submit(p3, 6)
+    np.testing.assert_array_equal(got, np.asarray(fresh.run()[rf]))
+
+
+# -- constraints=None costs nothing ------------------------------------
+
+
+def test_constraints_off_bit_identical_and_trace_stable(model, cons):
+    """Satellite contract: with no constrained row live, the server
+    dispatches the PRE-CONSTRAINT programs — outputs bit-identical
+    between constraints=None and constraints-registered-but-unused
+    servers, and a warmed tick loop lowers nothing new (zero
+    post-warmup retraces)."""
+    dec, params = model
+    reqs = [
+        (jnp.asarray([[3, 9, 27]], jnp.int32), 10),
+        (jnp.asarray([[5, 1]], jnp.int32), 9),
+    ]
+    outs = []
+    for constraints in (None, cons):
+        srv = PagedDecodeServer(
+            dec, params, num_blocks=12, block_size=4, max_batch=2,
+            eos_id=EOS, constraints=constraints,
+        )
+        rids = [srv.submit(p, s) for p, s in reqs]
+        srv._admit()
+        for _ in range(2):  # warmup: first ticks compile the step
+            srv._tick()
+        with sanitize(srv, dec) as rep:
+            for _ in range(3):
+                srv._tick()
+        assert rep.retraces == 0
+        done = srv.run()
+        outs.append([np.asarray(done[r]) for r in rids])
+    for a, b in zip(*outs):
+        np.testing.assert_array_equal(a, b)
+
+
+# -- obs ---------------------------------------------------------------
+
+
+def test_constrain_metrics_surface(model, cons):
+    from defer_tpu.obs import get_registry
+    from defer_tpu.obs import reset as obs_reset
+
+    obs_reset()
+    (outs, stats), _ = _serve(model, cons)
+    reg = get_registry()
+    lab = {"server": "paged"}
+    ct = reg.value("defer_constrained_tokens_total", **lab)
+    assert ct == stats["constrained_tokens"] > 0
+    frac = reg.value("defer_constrain_masked_frac", **lab)
+    assert frac["count"] == ct  # one observation per constrained token
+    assert reg.value("defer_constrain_dead_ends_total", **lab) == 0
+    # The snapshot inside stats carries the same series.
+    key = 'defer_constrained_tokens_total{server="paged"}'
+    assert stats["metrics"]["counters"][key] == ct
