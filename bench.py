@@ -1126,20 +1126,36 @@ def run_bench() -> dict:
     # Static self-check rides along so the artifact records lint drift
     # next to the perf numbers (also published on the obs registry as
     # defer_analysis_findings_total{rule=...}). Sub-second, pure AST.
+    # The perf-contract budgets cross-check against THIS round's
+    # numbers (the in-memory result dict), so a regression the bench
+    # just measured is flagged in the same artifact that measured it.
     try:
         from defer_tpu.analysis import analyze_paths
         from defer_tpu.analysis.runner import record_findings
 
-        pkg = os.path.join(
-            os.path.dirname(os.path.abspath(__file__)), "defer_tpu"
+        root = os.path.dirname(os.path.abspath(__file__))
+        pkg = os.path.join(root, "defer_tpu")
+        budgets = os.path.join(root, "budgets.toml")
+        rep = analyze_paths(
+            [pkg],
+            strict=True,
+            budget=budgets if os.path.exists(budgets) else None,
+            bench=result,
         )
-        rep = analyze_paths([pkg], strict=True)
         record_findings(rep)
         result["analysis"] = {
             "findings": len(rep.findings),
             "suppressed": len(rep.suppressed),
             "counts": rep.counts,
+            "suppressed_by_rule": rep.suppressed_by_rule,
         }
+        if rep.budget is not None:
+            result["analysis"]["budget"] = {
+                c["contract"]: {
+                    "status": c["status"], "value": c["value"],
+                }
+                for c in rep.budget["contracts"]
+            }
     except Exception as e:  # noqa: BLE001 — extra datapoint only
         log(f"analysis probe failed ({type(e).__name__}: {e})")
     snapshot(result)

@@ -63,21 +63,70 @@ class FuncInfo:
     # only bare-callable where it is lexically visible, and is never a
     # valid `obj.name(...)` target — both resolutions use this.
     scope: tuple[str, ...] = ()
+    # Innermost enclosing CLASS at the def site (None for plain
+    # functions). `self.x` writes in a method mutate an instance of
+    # this class — the race detector keys shared state on it.
+    owner_class: str | None = None
 
     @property
     def in_function(self) -> bool:
         return bool(self.scope)
 
 
+@dataclasses.dataclass(frozen=True)
+class ThreadSite:
+    """One ``threading.Thread(target=...)`` spawn: a thread edge. The
+    target function runs on a NEW thread, so the hot set must not flow
+    through it, but the mutation-domain pass (domains.py) roots a
+    thread domain at every resolvable target."""
+
+    path: str
+    line: int
+    target_bare: str | None  # Thread(target=feed)
+    target_attr: str | None  # Thread(target=self._drain_loop)
+    thread_name: str | None  # the name= kwarg, when a string literal
+    in_func: str | None  # bare name of the spawning function
+
+
+def _thread_target(call: ast.Call) -> tuple[str | None, str | None] | None:
+    """(bare, attr) target names of a threading.Thread(...) call, or
+    None if this call is not a Thread construction / has no target."""
+    f = call.func
+    name = (
+        f.id if isinstance(f, ast.Name)
+        else f.attr if isinstance(f, ast.Attribute)
+        else None
+    )
+    if name != "Thread":
+        return None
+    tgt = next(
+        (k.value for k in call.keywords if k.arg == "target"), None
+    )
+    if isinstance(tgt, ast.Name):
+        return tgt.id, None
+    if isinstance(tgt, ast.Attribute):
+        return None, tgt.attr
+    return None
+
+
 class _Collector(ast.NodeVisitor):
-    def __init__(self, path: str, out: list[FuncInfo]):
+    def __init__(
+        self,
+        path: str,
+        out: list[FuncInfo],
+        threads: list[ThreadSite] | None = None,
+    ):
         self.path = path
         self.out = out
+        self.threads = threads if threads is not None else []
         self.stack: list[str] = []  # class/function name chain
         self.kinds: list[str] = []  # "class" | "func", parallel to stack
 
     def _visit_func(self, node: ast.AST) -> None:
         qual = ".".join([*self.stack, node.name])
+        classes = [
+            n for n, k in zip(self.stack, self.kinds) if k == "class"
+        ]
         info = FuncInfo(
             name=node.name,
             qualname=f"{self.path}:{qual}",
@@ -88,6 +137,7 @@ class _Collector(ast.NodeVisitor):
                 for n, k in zip(self.stack, self.kinds)
                 if k == "func"
             ),
+            owner_class=classes[-1] if classes else None,
         )
         for sub in ast.walk(node):
             if isinstance(sub, ast.Call):
@@ -143,6 +193,33 @@ class _Collector(ast.NodeVisitor):
         self.stack.pop()
         self.kinds.pop()
 
+    def visit_Call(self, node: ast.Call) -> None:
+        tgt = _thread_target(node)
+        if tgt is not None:
+            name_kw = next(
+                (k.value for k in node.keywords if k.arg == "name"),
+                None,
+            )
+            funcs = [
+                n for n, k in zip(self.stack, self.kinds) if k == "func"
+            ]
+            self.threads.append(
+                ThreadSite(
+                    path=self.path,
+                    line=node.lineno,
+                    target_bare=tgt[0],
+                    target_attr=tgt[1],
+                    thread_name=(
+                        name_kw.value
+                        if isinstance(name_kw, ast.Constant)
+                        and isinstance(name_kw.value, str)
+                        else None
+                    ),
+                    in_func=funcs[-1] if funcs else None,
+                )
+            )
+        self.generic_visit(node)
+
 
 class CallGraph:
     """Functions of the analyzed file set + name-resolved call edges."""
@@ -151,10 +228,11 @@ class CallGraph:
         self.functions: list[FuncInfo] = []
         self.by_name: dict[str, list[FuncInfo]] = {}
         self.imports: dict[str, set[str]] = {}  # path -> imported names
+        self.thread_sites: list[ThreadSite] = []
 
     def add_module(self, path: str, tree: ast.AST) -> None:
         found: list[FuncInfo] = []
-        _Collector(path, found).visit(tree)
+        _Collector(path, found, self.thread_sites).visit(tree)
         self.functions.extend(found)
         for fi in found:
             self.by_name.setdefault(fi.name, []).append(fi)
@@ -181,6 +259,56 @@ class CallGraph:
                 ]
             return out
         return [c for c in cands if not c.in_function]
+
+    def resolve_call(
+        self, fi: FuncInfo | None, callee: str, bare: bool
+    ) -> list[FuncInfo]:
+        """Public name resolution for passes that walk call sites
+        themselves (lock-discipline helper lookup, domain propagation).
+        `fi` scopes bare-call resolution; None means module-level
+        resolution is impossible, so only corpus-wide attr resolution
+        applies. Generic attr names (get/put/start/...) resolve to
+        nothing, same as edge collection."""
+        if not bare and callee in _GENERIC_ATTRS:
+            return []
+        if fi is None:
+            if bare:
+                return []
+            return [
+                c
+                for c in self.by_name.get(callee, [])
+                if not c.in_function
+            ]
+        return self._resolve(fi, callee, bare)
+
+    def resolve_thread_target(self, site: ThreadSite) -> list[FuncInfo]:
+        """FuncInfos a Thread(target=...) site may start. Bare targets
+        resolve within the spawning module (plus from-imports, e.g.
+        Thread(target=serve_prefill)); attr targets corpus-wide."""
+        if site.target_bare is not None:
+            cands = self.by_name.get(site.target_bare, [])
+            out = [c for c in cands if c.path == site.path]
+            if site.target_bare in self.imports.get(site.path, ()):
+                out += [
+                    c
+                    for c in cands
+                    if c.path != site.path and not c.in_function
+                ]
+            return out
+        if site.target_attr is not None:
+            cands = [
+                c
+                for c in self.by_name.get(site.target_attr, [])
+                if not c.in_function
+            ]
+            # `Thread(target=self._drain_loop)` names a method of the
+            # spawning class — prefer same-module candidates and only
+            # fall back corpus-wide when the module defines none, so a
+            # common method name (`_loop`) doesn't seed a thread
+            # domain on every unrelated class that uses it.
+            local = [c for c in cands if c.path == site.path]
+            return local or cands
+        return []
 
     def hot_set(self, roots: tuple[str, ...] = DEFAULT_ROOTS) -> set[int]:
         """ids of FuncInfo.node for every function reachable by name

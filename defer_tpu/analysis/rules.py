@@ -496,41 +496,107 @@ def _mentions_lock(node: ast.AST) -> bool:
     return False
 
 
+def _first_blocking_call(fn_node: ast.AST) -> tuple[str, int] | None:
+    """(name, line) of the first blocking call in a function's own
+    body (nested defs excluded), else None."""
+    for sub in _walk_shallow(fn_node):
+        if not isinstance(sub, ast.Call):
+            continue
+        f = sub.func
+        name = (
+            f.attr
+            if isinstance(f, ast.Attribute)
+            else f.id
+            if isinstance(f, ast.Name)
+            else None
+        )
+        if name in _BLOCKING:
+            return name, sub.lineno
+    return None
+
+
+def _lock_body_calls(
+    node: ast.With | ast.AsyncWith,
+) -> Iterator[ast.Call]:
+    for stmt in node.body:
+        for sub in [stmt, *_walk_shallow(stmt)]:
+            if isinstance(sub, ast.Call):
+                yield sub
+
+
 def rule_lock_discipline(ctx: Context) -> list[Finding]:
     out: list[Finding] = []
+
+    def check_with(node: ast.AST, fi: FuncInfo | None, path: str) -> None:
+        if not any(
+            _mentions_lock(item.context_expr) for item in node.items
+        ):
+            return
+        for sub in _lock_body_calls(node):
+            f = sub.func
+            bare = isinstance(f, ast.Name)
+            name = (
+                f.attr
+                if isinstance(f, ast.Attribute)
+                else f.id
+                if bare
+                else None
+            )
+            if name is None:
+                continue
+            if name in _BLOCKING:
+                out.append(
+                    Finding(
+                        "lock-discipline",
+                        path,
+                        sub.lineno,
+                        sub.col_offset,
+                        f"blocking call .{name}() while holding "
+                        "a lock — every other thread touching "
+                        "this lock stalls behind the I/O; move "
+                        "the wait outside the critical section",
+                    )
+                )
+                continue
+            # One level through the callgraph: a helper whose own body
+            # blocks is the same stall, just hidden behind a call. Any
+            # name-resolved candidate blocking is a finding (open-world
+            # recall bias, same as the hot set).
+            for cand in ctx.graph.resolve_call(fi, name, bare):
+                hit = _first_blocking_call(cand.node)
+                if hit is not None:
+                    out.append(
+                        Finding(
+                            "lock-discipline",
+                            path,
+                            sub.lineno,
+                            sub.col_offset,
+                            f"`{name}()` called under a lock blocks "
+                            f"inside (.{hit[0]}() at "
+                            f"{cand.path}:{hit[1]}) — the critical "
+                            "section stalls behind that I/O exactly "
+                            "as if it were inline; move the call "
+                            "outside the lock",
+                        )
+                    )
+                    break
+
+    # With blocks inside functions: resolved with lexical scope so
+    # bare helper calls link right. Module-level withs (no enclosing
+    # function) still get direct + attr-helper checks.
+    seen: set[int] = set()
+    for fi in ctx.graph.functions:
+        for node in _walk_shallow(fi.node):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                seen.add(id(node))
+                check_with(node, fi, fi.path)
     for mod in ctx.modules:
         for node in ast.walk(mod.tree):
-            if not isinstance(node, (ast.With, ast.AsyncWith)):
-                continue
-            if not any(
-                _mentions_lock(item.context_expr) for item in node.items
+            if (
+                isinstance(node, (ast.With, ast.AsyncWith))
+                and id(node) not in seen
             ):
-                continue
-            for stmt in node.body:
-                for sub in [stmt, *_walk_shallow(stmt)]:
-                    if not isinstance(sub, ast.Call):
-                        continue
-                    f = sub.func
-                    name = (
-                        f.attr
-                        if isinstance(f, ast.Attribute)
-                        else f.id
-                        if isinstance(f, ast.Name)
-                        else None
-                    )
-                    if name in _BLOCKING:
-                        out.append(
-                            Finding(
-                                "lock-discipline",
-                                mod.path,
-                                sub.lineno,
-                                sub.col_offset,
-                                f"blocking call .{name}() while holding "
-                                "a lock — every other thread touching "
-                                "this lock stalls behind the I/O; move "
-                                "the wait outside the critical section",
-                            )
-                        )
+                check_with(node, None, mod.path)
     return out
 
 

@@ -21,12 +21,29 @@ from defer_tpu.analysis.callgraph import DEFAULT_ROOTS, CallGraph
 from defer_tpu.analysis.ignore import Ignore, IgnoreMap
 from defer_tpu.analysis.rules import RULES, Context, Finding, Module
 
+# Self-registering passes: importing them adds their rules to RULES
+# (cross-domain-write, shard-spec). The budget pass is not a RULES
+# entry — it only runs when --budget names a contracts file.
+import defer_tpu.analysis.domains  # noqa: E402,F401
+import defer_tpu.analysis.shardcheck  # noqa: E402,F401
+from defer_tpu.analysis.budget import (  # noqa: E402
+    BudgetError,
+    bench_findings,
+    check_static,
+    evaluate_bench,
+    latest_bench_json,
+    load_budgets,
+)
+
 
 @dataclasses.dataclass
 class AnalysisReport:
     findings: list[Finding]  # active (unsuppressed) findings
     suppressed: list[tuple[Finding, Ignore]]
     files: int
+    # Per-contract verdicts when the run carried a budgets file
+    # ({"path": ..., "bench": ..., "contracts": [...]}); None otherwise.
+    budget: dict[str, Any] | None = None
 
     @property
     def counts(self) -> dict[str, int]:
@@ -35,13 +52,26 @@ class AnalysisReport:
             out[f.rule] = out.get(f.rule, 0) + 1
         return out
 
+    @property
+    def suppressed_by_rule(self) -> dict[str, int]:
+        """Suppression counts per rule — the growth signal --strict
+        prints so an ignore-sprawl trend is visible in CI output."""
+        out: dict[str, int] = {}
+        for f, _ in self.suppressed:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
     def to_dict(self) -> dict[str, Any]:
-        return {
+        out = {
             "findings": [dataclasses.asdict(f) for f in self.findings],
             "counts": self.counts,
             "suppressed": len(self.suppressed),
+            "suppressed_by_rule": self.suppressed_by_rule,
             "files": self.files,
         }
+        if self.budget is not None:
+            out["budget"] = self.budget
+        return out
 
 
 def _collect_files(paths: Sequence[str]) -> list[str]:
@@ -66,8 +96,18 @@ def analyze_paths(
     rules: Sequence[str] | None = None,
     roots: Sequence[str] = DEFAULT_ROOTS,
     strict: bool = False,
+    budget: str | None = None,
+    bench: str | dict | None = None,
 ) -> AnalysisReport:
-    """Run the (selected) rules over every .py file under `paths`."""
+    """Run the (selected) rules over every .py file under `paths`.
+
+    `budget` names a contracts file (budgets.toml) to enforce; `bench`
+    optionally supplies measured numbers for its cross-check — a path
+    to a BENCH_*.json, or the in-memory result dict when bench.py
+    calls in on itself. With `budget` set and `bench` unset, the
+    newest BENCH_*.json in the current directory is used when present.
+    Raises BudgetError (a ValueError) on a malformed contracts file.
+    """
     unknown = set(rules or ()) - set(RULES)
     if unknown:
         raise ValueError(f"unknown rules: {sorted(unknown)}")
@@ -93,6 +133,34 @@ def analyze_paths(
             continue
         raw.extend(fn(ctx))
 
+    budget_state: dict[str, Any] | None = None
+    if budget is not None:
+        contracts = load_budgets(budget)  # raises BudgetError
+        raw.extend(check_static(ctx, contracts, budget))
+        bench_data: dict | None = None
+        source_name = ""
+        if isinstance(bench, dict):
+            bench_data, source_name = bench, "<in-memory bench result>"
+        elif isinstance(bench, str):
+            with open(bench, encoding="utf-8") as fh:
+                bench_data = json.load(fh)
+            source_name = bench
+        else:
+            found = latest_bench_json(".")
+            if found is not None:
+                source_name, bench_data = found
+        verdicts = (
+            evaluate_bench(contracts, bench_data, source_name)
+            if bench_data is not None
+            else evaluate_bench(contracts, {}, "<no bench data>")
+        )
+        raw.extend(bench_findings(verdicts, contracts, budget))
+        budget_state = {
+            "path": budget,
+            "bench": source_name or None,
+            "contracts": verdicts,
+        }
+
     active: list[Finding] = []
     suppressed: list[tuple[Finding, Ignore]] = []
     for f in raw:
@@ -116,7 +184,7 @@ def analyze_paths(
         else:
             suppressed.append((f, ign))
     active.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
-    return AnalysisReport(active, suppressed, len(modules))
+    return AnalysisReport(active, suppressed, len(modules), budget_state)
 
 
 def record_findings(report: AnalysisReport, registry: Any = None) -> None:
@@ -170,6 +238,18 @@ def main(argv: Sequence[str] | None = None) -> int:
         "--list-rules", action="store_true",
         help="print rule names and exit",
     )
+    ap.add_argument(
+        "--budget", default=None, metavar="BUDGETS_TOML",
+        help=(
+            "enforce the perf contracts declared in this file "
+            "(static counter-touch checks always; measured bounds "
+            "against --bench or the newest BENCH_*.json in cwd)"
+        ),
+    )
+    ap.add_argument(
+        "--bench", default=None, metavar="BENCH_JSON",
+        help="bench artifact for the --budget measured cross-check",
+    )
     args = ap.parse_args(argv)
     if args.list_rules:
         print("\n".join(RULES))
@@ -183,8 +263,10 @@ def main(argv: Sequence[str] | None = None) -> int:
                 else DEFAULT_ROOTS
             ),
             strict=args.strict,
+            budget=args.budget,
+            bench=args.bench,
         )
-    except ValueError as e:
+    except (ValueError, OSError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
     try:
@@ -196,6 +278,22 @@ def main(argv: Sequence[str] | None = None) -> int:
     else:
         for f in report.findings:
             print(f.format())
+        if args.strict and report.suppressed:
+            # The ignore ledger: per-rule suppression counts, so CI
+            # output shows growth even while the gate stays green.
+            print("suppressions by rule:", file=sys.stderr)
+            for rule, n in sorted(report.suppressed_by_rule.items()):
+                print(f"  {rule:24s} {n:3d}", file=sys.stderr)
+        if report.budget is not None:
+            bench_src = report.budget["bench"] or "none found"
+            print(f"budget: {report.budget['path']} "
+                  f"(bench: {bench_src})", file=sys.stderr)
+            for v in report.budget["contracts"]:
+                val = "" if v["value"] is None else f" = {v['value']}"
+                print(
+                    f"  {v['contract']:28s} {v['status']}{val}",
+                    file=sys.stderr,
+                )
         print(
             f"{len(report.findings)} finding(s), "
             f"{len(report.suppressed)} suppressed, "

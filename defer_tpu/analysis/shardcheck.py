@@ -1,0 +1,561 @@
+"""Pass 2 — shard_map spec consistency (`shard-spec`).
+
+Every hot tick body runs under ``shard_map`` with hand-maintained
+specs, and nothing but discipline keeps those specs aligned with the
+body signatures, the mesh axes and the collective accounting. Four
+statically checkable contracts:
+
+1. **Arity**: ``in_specs`` is positional — a tuple with one entry per
+   body parameter. The tuple length is computed for literal-ish
+   expressions (``(a, b, c) + (r,) * 11``) and compared against the
+   body's signature when the body resolves to a local ``def`` or
+   lambda. A mismatch traces as a confusing pytree error at runtime;
+   here it is one line.
+2. **Axis names**: ``PartitionSpec("model")`` names an axis that must
+   exist on the mesh. When the mesh is constructed nearby from
+   literal axis names (``Mesh(devs, ("model",))``,
+   ``make_mesh({"model": 2}, ...)``), the axis sets are compared;
+   dynamic meshes (``self.mesh``) are skipped, fixtures pin the check.
+3. **check_rep=False**: disabling the replication checker is
+   sometimes required (a body ending in a tiled all_gather the checker
+   can't infer) but never free — each such site must carry a justified
+   ``# analysis: ignore[shard-spec] reason`` on the ``check_rep`` line,
+   the same escape-hatch discipline every other rule uses.
+4. **psum mirror**: the host-side ``defer_tp_psum_total`` counter is
+   driven by a mirror constant (``_psums_per_fwd = A * num_layers +
+   B`` in runtime/paged.py). The pass re-derives A and B from the
+   jitted bodies — A = branch-collapsed ``lax.psum`` sites across the
+   per-layer trio ``_block``/``_attn_qkv``/``_attn_out``, B = psum
+   sites in ``embed_lookup`` plus ``all_gather`` sites in
+   ``_replicate_logits`` — and flags the mirror when the code moved
+   out from under it. (Branch-collapsed: exclusive if/else arms count
+   once, an early-``return`` arm does not see later sites.)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from defer_tpu.analysis.callgraph import FuncInfo
+from defer_tpu.analysis.rules import (
+    RULES,
+    Context,
+    Finding,
+    _FUNC_NODES,
+)
+
+_SPEC_NAMES = {"P", "PSpec", "PartitionSpec"}
+
+# The psum-mirror convention (check 4): mirror attribute, the
+# per-layer functions whose psum sites the A coefficient counts, and
+# the per-forward constant functions for B.
+MIRROR_ATTR = "_psums_per_fwd"
+PER_LAYER_FUNCS = ("_block", "_attn_qkv", "_attn_out")
+CONST_PSUM_FUNC = "embed_lookup"
+CONST_GATHER_FUNC = "_replicate_logits"
+
+
+def _callee_name(call: ast.Call) -> str | None:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def _kwarg(call: ast.Call, name: str) -> ast.AST | None:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _spec_tuple_len(expr: ast.AST) -> int | None:
+    """Statically computable length of an in_specs expression:
+    literal tuples, + concatenation, and tuple * <int literal>."""
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        if any(isinstance(e, ast.Starred) for e in expr.elts):
+            return None
+        return len(expr.elts)
+    if isinstance(expr, ast.BinOp):
+        if isinstance(expr.op, ast.Add):
+            left = _spec_tuple_len(expr.left)
+            right = _spec_tuple_len(expr.right)
+            if left is None or right is None:
+                return None
+            return left + right
+        if isinstance(expr.op, ast.Mult):
+            for tup, n in (
+                (expr.left, expr.right),
+                (expr.right, expr.left),
+            ):
+                tl = _spec_tuple_len(tup)
+                if (
+                    tl is not None
+                    and isinstance(n, ast.Constant)
+                    and isinstance(n.value, int)
+                ):
+                    return tl * n.value
+    return None
+
+
+def _positional_arity(node: ast.AST) -> int | None:
+    """Positional parameter count of a def/lambda; None when *args
+    makes the arity open."""
+    a = node.args
+    if a.vararg is not None:
+        return None
+    return len(a.posonlyargs) + len(a.args)
+
+
+def _resolve_body(
+    ctx: Context, fi: FuncInfo | None, expr: ast.AST
+) -> ast.AST | None:
+    """The def/lambda node a shard_map body expression names, when
+    that is decidable: an inline lambda, or a Name resolving to
+    exactly one lexically visible function (the innermost match)."""
+    if isinstance(expr, ast.Lambda):
+        return expr
+    if not isinstance(expr, ast.Name) or fi is None:
+        return None
+    chain = (*fi.scope, fi.name)
+    cands = [
+        c
+        for c in ctx.graph.by_name.get(expr.id, [])
+        if c.path == fi.path and c.scope == chain[: len(c.scope)]
+    ]
+    if not cands:
+        return None
+    deepest = max(len(c.scope) for c in cands)
+    cands = [c for c in cands if len(c.scope) == deepest]
+    return cands[0].node if len(cands) == 1 else None
+
+
+def _axis_names_used(expr: ast.AST) -> Iterator[tuple[str, ast.AST]]:
+    """String-literal axis names inside PartitionSpec(...) calls of a
+    specs expression (dynamic entries are silently unknowable)."""
+    for node in ast.walk(expr):
+        if not isinstance(node, ast.Call):
+            continue
+        if _callee_name(node) not in _SPEC_NAMES:
+            continue
+        for arg in node.args:
+            elts = (
+                arg.elts
+                if isinstance(arg, (ast.Tuple, ast.List))
+                else [arg]
+            )
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(
+                    e.value, str
+                ):
+                    yield e.value, node
+
+
+def _literal_axes(expr: ast.AST) -> frozenset[str] | None:
+    """Axis names of a mesh-constructing expression, when literal:
+    Mesh(devs, ("a", "b")), Mesh(devs, axis_names=(...)),
+    make_mesh({"a": 2}, ...), jax.make_mesh((2,), ("a",))."""
+    if not isinstance(expr, ast.Call):
+        return None
+    name = _callee_name(expr)
+    cand: ast.AST | None = None
+    if name == "Mesh":
+        cand = _kwarg(expr, "axis_names")
+        if cand is None and len(expr.args) >= 2:
+            cand = expr.args[1]
+    elif name == "make_mesh":
+        cand = _kwarg(expr, "axis_names")
+        if cand is None and expr.args:
+            # repo make_mesh({"model": m}, ...) OR
+            # jax.make_mesh(shape, axis_names)
+            first = expr.args[0]
+            if isinstance(first, ast.Dict):
+                cand = first
+            elif len(expr.args) >= 2:
+                cand = expr.args[1]
+    if cand is None:
+        return None
+    if isinstance(cand, ast.Dict):
+        keys = [
+            k.value
+            for k in cand.keys
+            if isinstance(k, ast.Constant) and isinstance(k.value, str)
+        ]
+        return frozenset(keys) if len(keys) == len(cand.keys) else None
+    if isinstance(cand, (ast.Tuple, ast.List)):
+        out = []
+        for e in cand.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append(e.value)
+            else:
+                return None
+        return frozenset(out)
+    if isinstance(cand, ast.Constant) and isinstance(cand.value, str):
+        return frozenset([cand.value])
+    return None
+
+
+def _resolve_mesh_axes(
+    fi: FuncInfo | None, mesh_expr: ast.AST | None
+) -> frozenset[str] | None:
+    """Axis names of the mesh operand, when statically known: either
+    a literal construction at the call site, or a Name assigned from
+    one inside the same function body."""
+    if mesh_expr is None:
+        return None
+    axes = _literal_axes(mesh_expr)
+    if axes is not None:
+        return axes
+    if not isinstance(mesh_expr, ast.Name) or fi is None:
+        return None
+    found: frozenset[str] | None = None
+    for node in ast.walk(fi.node):
+        if isinstance(node, _FUNC_NODES) and node is not fi.node:
+            continue
+        if not isinstance(node, ast.Assign):
+            continue
+        for tgt in node.targets:
+            if (
+                isinstance(tgt, ast.Name)
+                and tgt.id == mesh_expr.id
+            ):
+                found = _literal_axes(node.value)
+    return found
+
+
+# -- psum mirror (check 4) --------------------------------------------
+
+
+def _count_calls_pathmax(
+    stmts: list[ast.stmt], attr: str
+) -> int:
+    """Max number of `attr`-named calls along any single execution
+    path through `stmts`. Exclusive if/else arms take the max arm; an
+    arm ending in return/raise/break/continue does not flow into the
+    statements after the If. Loops count their body once."""
+
+    def terminates(body: list[ast.stmt]) -> bool:
+        return bool(body) and isinstance(
+            body[-1],
+            (ast.Return, ast.Raise, ast.Break, ast.Continue),
+        )
+
+    def calls_in(node: ast.AST) -> int:
+        # shallow walk: nested def/lambda bodies are their own units
+        n = (
+            1
+            if isinstance(node, ast.Call) and _callee_name(node) == attr
+            else 0
+        )
+        stack = list(ast.iter_child_nodes(node))
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, _FUNC_NODES):
+                continue
+            if (
+                isinstance(sub, ast.Call)
+                and _callee_name(sub) == attr
+            ):
+                n += 1
+            stack.extend(ast.iter_child_nodes(sub))
+        return n
+
+    def block(body: list[ast.stmt]) -> int:
+        if not body:
+            return 0
+        head, rest = body[0], body[1:]
+        if isinstance(head, (*_FUNC_NODES, ast.ClassDef)):
+            return block(rest)
+        if isinstance(head, ast.If):
+            r = block(rest)
+            v_then = block(head.body) + (
+                0 if terminates(head.body) else r
+            )
+            v_else = block(head.orelse) + (
+                0 if terminates(head.orelse) else r
+            )
+            return calls_in(head.test) + max(v_then, v_else)
+        if isinstance(head, (ast.For, ast.AsyncFor, ast.While)):
+            return (
+                calls_in(
+                    head.iter
+                    if isinstance(head, (ast.For, ast.AsyncFor))
+                    else head.test
+                )
+                + block(head.body)
+                + block(head.orelse)
+                + block(rest)
+            )
+        if isinstance(head, (ast.With, ast.AsyncWith)):
+            n = sum(calls_in(i.context_expr) for i in head.items)
+            return n + block(head.body) + block(rest)
+        if isinstance(head, ast.Try):
+            n = block(head.body) + max(
+                [0] + [block(h.body) for h in head.handlers]
+            )
+            return (
+                n
+                + block(head.orelse)
+                + block(head.finalbody)
+                + block(rest)
+            )
+        if isinstance(head, ast.Return):
+            return calls_in(head)
+        return calls_in(head) + block(rest)
+
+    return block(stmts)
+
+
+def _pathmax_for_name(ctx: Context, name: str, attr: str) -> int | None:
+    """Branch-collapsed `attr`-call count for the function(s) named
+    `name` in the corpus (max across same-named candidates); None when
+    the name is absent."""
+    cands = ctx.graph.by_name.get(name, [])
+    if not cands:
+        return None
+    return max(
+        _count_calls_pathmax(list(c.node.body), attr) for c in cands
+    )
+
+
+def _mirror_terms(expr: ast.AST) -> tuple[int, int] | None:
+    """(A, B) of a mirror expression `A * <...num_layers...> + B`
+    (either operand order; IfExp takes the then-arm)."""
+    if isinstance(expr, ast.IfExp):
+        expr = expr.body
+    if not (
+        isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add)
+    ):
+        return None
+    const: int | None = None
+    mult: ast.BinOp | None = None
+    for side in (expr.left, expr.right):
+        if isinstance(side, ast.Constant) and isinstance(
+            side.value, int
+        ):
+            const = side.value
+        elif isinstance(side, ast.BinOp) and isinstance(
+            side.op, ast.Mult
+        ):
+            mult = side
+    if const is None or mult is None:
+        return None
+    for side in (mult.left, mult.right):
+        if isinstance(side, ast.Constant) and isinstance(
+            side.value, int
+        ):
+            return side.value, const
+    return None
+
+
+def _check_psum_mirror(ctx: Context) -> list[Finding]:
+    mirror: tuple[str, ast.Assign] | None = None
+    for mod in ctx.modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                if (
+                    isinstance(tgt, ast.Attribute)
+                    and tgt.attr == MIRROR_ATTR
+                ):
+                    mirror = (mod.path, node)
+    if mirror is None:
+        return []
+    per_layer_actual = 0
+    seen_any = False
+    for name in PER_LAYER_FUNCS:
+        n = _pathmax_for_name(ctx, name, "psum")
+        if n is not None:
+            seen_any = True
+            per_layer_actual += n
+    if not seen_any:
+        return []  # partial corpus (mirror without the model): skip
+    const_actual = (
+        (_pathmax_for_name(ctx, CONST_PSUM_FUNC, "psum") or 0)
+        + (_pathmax_for_name(ctx, CONST_GATHER_FUNC, "all_gather") or 0)
+    )
+    path, node = mirror
+    terms = _mirror_terms(node.value)
+    if terms is None:
+        return [
+            Finding(
+                "shard-spec",
+                path,
+                node.lineno,
+                node.col_offset,
+                f"`{MIRROR_ATTR}` mirror is not of the checkable "
+                "form `A * num_layers + B` — keep the "
+                "defer_tp_psum_total mirror a statically auditable "
+                "affine formula",
+            )
+        ]
+    a, b = terms
+    out: list[Finding] = []
+    if a != per_layer_actual:
+        out.append(
+            Finding(
+                "shard-spec",
+                path,
+                node.lineno,
+                node.col_offset,
+                f"`{MIRROR_ATTR}` claims {a} collectives per layer "
+                f"but {'/'.join(PER_LAYER_FUNCS)} contain "
+                f"{per_layer_actual} branch-collapsed psum site(s) — "
+                "the defer_tp_psum_total mirror drifted from the "
+                "sharded forward",
+            )
+        )
+    if b != const_actual:
+        out.append(
+            Finding(
+                "shard-spec",
+                path,
+                node.lineno,
+                node.col_offset,
+                f"`{MIRROR_ATTR}` claims {b} per-forward collectives "
+                f"outside the layer stack but {CONST_PSUM_FUNC} + "
+                f"{CONST_GATHER_FUNC} contain {const_actual} "
+                "(psum + all_gather) site(s) — the "
+                "defer_tp_psum_total mirror drifted",
+            )
+        )
+    return out
+
+
+# -- the rule ----------------------------------------------------------
+
+
+def _shard_map_sites(
+    ctx: Context,
+) -> Iterator[tuple[FuncInfo | None, ast.Call, str]]:
+    seen: set[int] = set()
+    for fi in ctx.graph.functions:
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Call) and _callee_name(
+                node
+            ) == "shard_map":
+                # ast.walk from an OUTER function also reaches nested
+                # defs' bodies; attribute each site to the innermost
+                # function so bare-name body resolution scopes right.
+                seen.add(id(node))
+                yield fi, node, fi.path
+    for mod in ctx.modules:
+        for node in ast.walk(mod.tree):
+            if (
+                isinstance(node, ast.Call)
+                and _callee_name(node) == "shard_map"
+                and id(node) not in seen
+            ):
+                yield None, node, mod.path
+
+
+def rule_shard_spec(ctx: Context) -> list[Finding]:
+    out: list[Finding] = []
+    # innermost-function attribution: map call id -> (fi, call, path)
+    sites: dict[int, tuple[FuncInfo | None, ast.Call, str]] = {}
+    for fi, call, path in _shard_map_sites(ctx):
+        prev = sites.get(id(call))
+        if prev is None or (
+            fi is not None
+            and (prev[0] is None or len(fi.scope) >= len(prev[0].scope))
+        ):
+            sites[id(call)] = (fi, call, path)
+    for fi, call, path in sites.values():
+        if not call.args and _kwarg(call, "f") is None:
+            continue
+        # The compat wrapper's own def-site (forwarding check_rep as a
+        # Name) is not a site; only calls are examined here.
+        body_expr = call.args[0] if call.args else _kwarg(call, "f")
+        mesh_expr = (
+            call.args[1] if len(call.args) >= 2 else _kwarg(call, "mesh")
+        )
+        in_specs = (
+            _kwarg(call, "in_specs")
+            if _kwarg(call, "in_specs") is not None
+            else (call.args[2] if len(call.args) >= 3 else None)
+        )
+        out_specs = (
+            _kwarg(call, "out_specs")
+            if _kwarg(call, "out_specs") is not None
+            else (call.args[3] if len(call.args) >= 4 else None)
+        )
+
+        # 1. arity
+        body = _resolve_body(ctx, fi, body_expr)
+        if body is not None and in_specs is not None:
+            arity = _positional_arity(body)
+            specs_len = _spec_tuple_len(in_specs)
+            if (
+                arity is not None
+                and specs_len is not None
+                and arity != specs_len
+            ):
+                bname = (
+                    body_expr.id
+                    if isinstance(body_expr, ast.Name)
+                    else "<lambda>"
+                )
+                out.append(
+                    Finding(
+                        "shard-spec",
+                        path,
+                        call.lineno,
+                        call.col_offset,
+                        f"shard_map in_specs has {specs_len} "
+                        f"entr{'y' if specs_len == 1 else 'ies'} but "
+                        f"body `{bname}` takes {arity} positional "
+                        "parameter(s) — every operand needs exactly "
+                        "one spec",
+                    )
+                )
+
+        # 2. axis names
+        mesh_axes = _resolve_mesh_axes(fi, mesh_expr)
+        if mesh_axes is not None:
+            for specs in (in_specs, out_specs):
+                if specs is None:
+                    continue
+                for axis, p_call in _axis_names_used(specs):
+                    if axis not in mesh_axes:
+                        out.append(
+                            Finding(
+                                "shard-spec",
+                                path,
+                                p_call.lineno,
+                                p_call.col_offset,
+                                f"PartitionSpec names axis {axis!r} "
+                                "but the mesh only has "
+                                f"{sorted(mesh_axes)} — specs must "
+                                "name mesh axes",
+                            )
+                        )
+
+        # 3. check_rep=False demands a justified ignore
+        for kw in call.keywords:
+            if kw.arg in ("check_rep", "check_vma") and (
+                isinstance(kw.value, ast.Constant)
+                and kw.value.value is False
+            ):
+                out.append(
+                    Finding(
+                        "shard-spec",
+                        path,
+                        kw.value.lineno,
+                        kw.value.col_offset,
+                        f"{kw.arg}=False disables shard_map's "
+                        "replication checker — say why (a trailing "
+                        "`# analysis: ignore[shard-spec] reason`, "
+                        "e.g. the body ends in a tiled all_gather "
+                        "the checker cannot infer)",
+                    )
+                )
+
+    out.extend(_check_psum_mirror(ctx))
+    return out
+
+
+RULES["shard-spec"] = rule_shard_spec

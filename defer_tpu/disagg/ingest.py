@@ -92,6 +92,7 @@ class KVBlockIngest:
         )
         self._thread.start()
 
+    # analysis: domain(drain) owns the blocking receive; payloads park in _queue for the serving thread to pump
     def _drain_loop(self) -> None:
         while not self._closed:
             try:
@@ -103,11 +104,13 @@ class KVBlockIngest:
                 self.eof.set()
                 return
             except TransportError as e:
+                # analysis: ignore[cross-domain-write] error/failed are an Event-mediated handoff: write error THEN set failed; readers check failed first
                 self.error = e
                 self.failed.set()
             except Exception as e:  # noqa: BLE001 — surfaced to the
                 # orchestrator; a validation/shape error must not die
                 # silently on a daemon thread
+                # analysis: ignore[cross-domain-write] same Event-mediated handoff as the TransportError arm
                 self.error = e
                 self.failed.set()
                 return
@@ -146,6 +149,7 @@ class KVBlockIngest:
 
     # -- serving thread ---------------------------------------------------
 
+    # analysis: domain(serving) the pop half of the park/pump handoff
     def pump(self) -> int:
         """Pop every parked payload and deliver it to the server
         (serving-thread-only, see module docstring). Returns payloads
@@ -177,8 +181,10 @@ class KVBlockIngest:
             if rid not in self.delivered
         ]
 
+    # analysis: domain(serving) orchestrator-side rewire path
     def resume(self) -> None:
         """Un-park the drain thread onto a rewired connection."""
+        # analysis: ignore[cross-domain-write] the reverse leg of the Event handoff: drain is parked on _resume, so it cannot race this clear
         self.error = None
         self.failed.clear()
         self._resume.set()

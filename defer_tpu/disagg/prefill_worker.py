@@ -120,6 +120,7 @@ def run_prefill(
     )
 
 
+# analysis: domain(transport) one worker session per thread; all state is session-local, results cross by wire only
 def serve_prefill(
     listen_port: int = 0,
     *,

@@ -107,6 +107,7 @@ class ThreadReplica:
             # (and any caller reading stored_bytes) see a final value.
             self.srv._spill.close()
 
+    # analysis: domain(any) test seam — one pointer store, read-and-cleared by the loop; tearing is impossible and loss is acceptable
     def inject_failure(self, exc: BaseException) -> None:
         self._fail = exc
 
@@ -201,6 +202,7 @@ class ThreadReplica:
             headroom += len(srv.radix.lru)  # parked = evictable
         self.obs.pool_free[self.idx].set(headroom)
 
+    # analysis: domain(serving) the replica's loop IS its serving thread — all srv state is owned here, outside callers go through call()
     def _loop(self) -> None:
         srv = self.srv
         try:

@@ -136,6 +136,7 @@ class PeriodicDumper:
             log.info("metrics: %s", text)
         return text
 
+    # analysis: domain(transport) periodic exposition writes leave the process; server state is only read
     def _run(self) -> None:
         while not self._stop.wait(self.interval_s):
             try:

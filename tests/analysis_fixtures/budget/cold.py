@@ -1,0 +1,26 @@
+"""Budget fixture (regressed): the metrics are still registered, but
+``_tick`` stopped feeding all of them — the silent-regression failure
+mode the static half exists to catch (bench numbers go stale while
+still looking green). Every contract in budgets.toml must produce a
+perf-contract finding over this file, with no bench data needed."""
+
+
+class Metrics:
+    def __init__(self, reg):
+        self.host_dispatches = reg.counter(
+            "defer_host_dispatches_total", "host->device dispatches"
+        )
+        self.kv_rows_read = reg.counter(
+            "defer_kv_rows_read_total", "kv rows read per tick"
+        )
+        self.tokens_per_dispatch = reg.gauge(
+            "defer_tokens_per_dispatch", "tokens delivered per dispatch"
+        )
+
+
+class Server:
+    def _tick(self):
+        # No counter touches anywhere reachable from here.
+        out = self.step_fn(self.state)
+        self.state = out
+        return out

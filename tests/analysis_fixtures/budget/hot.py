@@ -1,0 +1,31 @@
+"""Budget fixture (healthy): a serving loop whose ``_tick`` feeds
+every counter that budgets.toml's contracts account through — the
+static half of all three contracts passes over this file. The
+tokens-per-dispatch gauge is fed one call away, through ``_drain``,
+to pin the reachable-touch BFS (a direct-touch-only check would
+wrongly fail that contract)."""
+
+
+class Metrics:
+    def __init__(self, reg):
+        self.host_dispatches = reg.counter(
+            "defer_host_dispatches_total", "host->device dispatches"
+        )
+        self.kv_rows_read = reg.counter(
+            "defer_kv_rows_read_total", "kv rows read per tick"
+        )
+        self.tokens_per_dispatch = reg.gauge(
+            "defer_tokens_per_dispatch", "tokens delivered per dispatch"
+        )
+
+
+class Server:
+    def _drain(self, toks):
+        self.obs.tokens_per_dispatch.set(len(toks))
+        return toks
+
+    def _tick(self):
+        out = self.step_fn(self.state)
+        self.obs.host_dispatches.inc()
+        self.obs.kv_rows_read.inc(self.rows)
+        return self._drain(out)

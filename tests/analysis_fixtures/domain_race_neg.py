@@ -1,0 +1,51 @@
+"""NEGATIVE fixture: cross-domain-write.
+
+The same two-thread spill shape, written the three sanctioned ways:
+
+  * shared stats mutated under the lock on BOTH sides — cross-domain
+    but mediated, so no finding;
+  * the payload itself handed off through a queue (park/pump): the
+    drain thread only parks, the serving tick pops and does every
+    store mutation itself — single writer by construction;
+  * a test seam annotated ``domain(any)``: its write never counts
+    toward a race, and the serving loop's own write to that slot is
+    then single-domain.
+"""
+
+import threading
+
+
+class CleanSpill:
+    def __init__(self, q):
+        self.q = q
+        self.store = {}
+        self.stats = 0
+        self.fail = None
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(
+            target=self._drain_loop, name="spill-drain", daemon=True
+        )
+
+    # analysis: domain(drain) parks payloads for the serving thread; store mutation stays on the pump side
+    def _drain_loop(self):
+        while True:
+            item = self.recv()
+            self.q.put(item)  # park: a method call, not an attr write
+            with self._lock:
+                self.stats += 1  # cross-domain but lock-mediated
+
+    def recv(self):
+        return ("k", 1)
+
+    def _tick(self):
+        item = self.q.get()  # pump: serving thread owns the store
+        if item is not None:
+            self.store[item[0]] = item[1]
+        with self._lock:
+            self.stats += 1
+        self.fail = None  # only serving writes this concretely
+        return len(self.store)
+
+    # analysis: domain(any) test seam — one pointer store, read-and-cleared by the loop
+    def inject_failure(self, exc):
+        self.fail = exc

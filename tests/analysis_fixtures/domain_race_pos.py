@@ -1,0 +1,31 @@
+"""POSITIVE fixture: cross-domain-write.
+
+A spill-store clone where the drain thread and the serving tick both
+write the same instance attribute with no lock and no park/pump
+handoff — the single-writer invariant the race detector enforces.
+Expected: 2 findings (each unlocked write is flagged against the
+other's domain).
+"""
+
+import threading
+
+
+class RacySpill:
+    def __init__(self, q):
+        self.q = q
+        self.store = {}
+        self._thread = threading.Thread(
+            target=self._drain_loop, name="spill-drain", daemon=True
+        )
+
+    # No domain annotation: the Thread site infers domain
+    # "spill-drain" from the name= literal.
+    def _drain_loop(self):
+        while True:
+            item = self.q.get()
+            self.store[item[0]] = item[1]  # drain-thread write
+
+    def _tick(self):
+        # Serving-root write to the same (class, attr) slot, unlocked.
+        self.store["hot"] = 1
+        return len(self.store)

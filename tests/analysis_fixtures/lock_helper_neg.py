@@ -1,0 +1,24 @@
+"""NEGATIVE fixture: lock-discipline through one callgraph level.
+
+The fixed shape: the critical section only encodes (pure compute in a
+helper) and bumps the sequence number; the blocking ``sendall`` runs
+after the lock is released, so no thread stalls behind the I/O.
+"""
+
+import threading
+
+
+class Framer:
+    def __init__(self, sock):
+        self.sock = sock
+        self.seq = 0
+        self._lock = threading.Lock()
+
+    def _encode(self, payload):
+        return len(payload).to_bytes(4, "big") + payload
+
+    def push(self, payload):
+        with self._lock:
+            frame = self._encode(payload)  # pure compute: fine
+            self.seq += 1
+        self.sock.sendall(frame)  # the wait lives outside the lock

@@ -1,0 +1,26 @@
+"""POSITIVE fixture: lock-discipline through one callgraph level.
+
+The blocking call is hidden one call deep: ``push`` holds the lock
+while calling ``_send_frame``, whose own body does the ``sendall``.
+The pre-PR rule only saw lexically-direct blocking calls and missed
+this; the helper's body is clean on its own (no lock held there), so
+the single finding must land on the call site under the lock.
+
+Expected: 1 finding.
+"""
+
+import threading
+
+
+class Framer:
+    def __init__(self, sock):
+        self.sock = sock
+        self._lock = threading.Lock()
+
+    def _send_frame(self, payload):
+        header = len(payload).to_bytes(4, "big")
+        self.sock.sendall(header + payload)
+
+    def push(self, payload):
+        with self._lock:
+            self._send_frame(payload)  # blocks inside, lock held

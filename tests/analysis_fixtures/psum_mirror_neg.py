@@ -1,0 +1,38 @@
+"""NEGATIVE fixture: shard-spec psum-mirror in sync.
+
+Identical model to psum_mirror_pos.py, mirror corrected to the true
+branch-collapsed accounting: 2 per-layer psum sites, 2 per-forward
+constants (embed psum + logits all_gather).
+"""
+
+from jax import lax
+
+
+class Server:
+    def __init__(self, cfg, mesh):
+        self.cfg = cfg
+        self._psums_per_fwd = (
+            2 * cfg.num_layers + 2 if mesh is not None else 0
+        )
+
+
+def _attn_qkv(x, shard):
+    if shard:
+        return lax.psum(x, "model")
+    return lax.psum(x * 2, "model")
+
+
+def _attn_out(x):
+    return lax.psum(x, "model")
+
+
+def _block(x, shard):
+    return _attn_out(_attn_qkv(x, shard))
+
+
+def embed_lookup(tab, ids):
+    return lax.psum(tab[ids], "model")
+
+
+def _replicate_logits(x):
+    return lax.all_gather(x, "model")

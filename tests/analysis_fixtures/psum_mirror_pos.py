@@ -1,0 +1,43 @@
+"""POSITIVE fixture: shard-spec psum-mirror drift.
+
+The host-side ``_psums_per_fwd`` mirror claims 3 collectives per layer
+but the per-layer trio below holds only 2 branch-collapsed psum sites
+(``_attn_qkv``'s if/else arms are exclusive — they count once, which
+is exactly the collapse a naive site count gets wrong). The
+per-forward constant term (embed psum + logits all_gather = 2) is
+correct, so exactly the A coefficient is flagged.
+
+Expected: 1 finding.
+"""
+
+from jax import lax
+
+
+class Server:
+    def __init__(self, cfg, mesh):
+        self.cfg = cfg
+        self._psums_per_fwd = (
+            3 * cfg.num_layers + 2 if mesh is not None else 0
+        )
+
+
+def _attn_qkv(x, shard):
+    if shard:
+        return lax.psum(x, "model")
+    return lax.psum(x * 2, "model")
+
+
+def _attn_out(x):
+    return lax.psum(x, "model")
+
+
+def _block(x, shard):
+    return _attn_out(_attn_qkv(x, shard))
+
+
+def embed_lookup(tab, ids):
+    return lax.psum(tab[ids], "model")
+
+
+def _replicate_logits(x):
+    return lax.all_gather(x, "model")

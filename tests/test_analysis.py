@@ -16,6 +16,7 @@ from defer_tpu.analysis import (
     analyze_paths,
     trace_sanitizer as sanitize,
 )
+from defer_tpu.analysis.budget import BudgetError
 from defer_tpu.analysis.runner import main, record_findings
 from defer_tpu.obs.metrics import MetricsRegistry
 
@@ -37,7 +38,11 @@ CASES = [
     ("prng-key-reuse", "prng_reuse", 1),
     ("lock-discipline", "lock_discipline", 2),
     ("lock-discipline", "advert_lock", 2),
+    ("lock-discipline", "lock_helper", 1),
     ("obs-name-drift", "obs_drift", 3),
+    ("cross-domain-write", "domain_race", 2),
+    ("shard-spec", "shard_spec", 3),
+    ("shard-spec", "psum_mirror", 1),
 ]
 
 
@@ -166,6 +171,108 @@ def test_findings_metric_recorded():
     assert reg.value(
         "defer_analysis_findings_total", rule="prng-key-reuse"
     ) == 0
+
+
+# -- perf-contract budget gate -----------------------------------------
+
+BUDGET = FIXTURES / "budget"
+
+
+def test_budget_static_and_bench_pass():
+    """Healthy tree + healthy numbers: both halves green."""
+    rep = analyze_paths(
+        [str(BUDGET / "hot.py")],
+        budget=str(BUDGET / "budgets.toml"),
+        bench=str(BUDGET / "bench_ok.json"),
+    )
+    assert rep.findings == [], [f.format() for f in rep.findings]
+    statuses = {
+        c["contract"]: c["status"] for c in rep.budget["contracts"]
+    }
+    assert statuses == {
+        "dispatches_per_token_w8": "pass",
+        "kv_rows_per_shard_tp2": "pass",
+        "window_drain_b_k": "pass",
+    }
+
+
+def test_budget_bench_violation_fails_cli(capsys):
+    """Acceptance check: a violated dispatches-per-token /
+    kv-rows-read bound exits non-zero with per-contract verdicts in
+    the JSON payload."""
+    rc = main([
+        str(BUDGET / "hot.py"),
+        "--budget", str(BUDGET / "budgets.toml"),
+        "--bench", str(BUDGET / "bench_bad.json"),
+        "--json",
+    ])
+    assert rc == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["counts"] == {"perf-contract": 3}
+    statuses = {
+        c["contract"]: c["status"] for c in out["budget"]["contracts"]
+    }
+    assert set(statuses.values()) == {"fail"}
+
+
+def test_budget_static_violation_needs_no_bench():
+    """cold.py registers the metrics but its _tick feeds none of them:
+    every contract fails statically even with green bench numbers."""
+    rep = analyze_paths(
+        [str(BUDGET / "cold.py")],
+        budget=str(BUDGET / "budgets.toml"),
+        bench=str(BUDGET / "bench_ok.json"),
+    )
+    assert [f.rule for f in rep.findings] == ["perf-contract"] * 3
+    assert all("nothing reachable" in f.message for f in rep.findings)
+
+
+def test_budget_missing_sections_are_no_data_not_fail():
+    """A bench round that never ran a section must not fail its
+    contract — only present-and-violated bounds do."""
+    rep = analyze_paths(
+        [str(BUDGET / "hot.py")],
+        budget=str(BUDGET / "budgets.toml"),
+        bench={"parsed": {"decode_window": {}}},
+    )
+    assert rep.findings == []
+    assert {c["status"] for c in rep.budget["contracts"]} == {"no-data"}
+    assert rep.budget["bench"] == "<in-memory bench result>"
+
+
+def test_budget_malformed_toml_rejected(tmp_path, capsys):
+    bad = tmp_path / "budgets.toml"
+    bad.write_text('[contract.x]\ncounter = 5\nfunctions = ["_tick"]\n')
+    with pytest.raises(BudgetError, match="counter"):
+        analyze_paths([str(BUDGET / "hot.py")], budget=str(bad))
+    assert main([str(BUDGET / "hot.py"), "--budget", str(bad)]) == 2
+    assert "counter" in capsys.readouterr().err
+
+
+def test_repo_budget_gate_and_suppression_ledger(capsys):
+    """The shipped gate: --strict --budget over defer_tpu/ stays green
+    (static half holds; measured half is pass or no-data, never fail
+    on committed artifacts), and the JSON payload carries the per-rule
+    suppression ledger."""
+    rc = main([
+        str(REPO / "defer_tpu"), "--strict", "--json",
+        "--budget", str(REPO / "budgets.toml"),
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["findings"] == []
+    ledger = out["suppressed_by_rule"]
+    assert ledger.get("host-sync-in-hot-loop", 0) >= 15
+    assert sum(ledger.values()) == out["suppressed"]
+    verdicts = {
+        c["contract"]: c["status"] for c in out["budget"]["contracts"]
+    }
+    assert set(verdicts) == {
+        "dispatches_per_token_w8",
+        "kv_rows_per_shard_tp2",
+        "window_drain_b_k",
+    }
+    assert all(s in ("pass", "no-data") for s in verdicts.values())
 
 
 # -- trace sanitizer ---------------------------------------------------
