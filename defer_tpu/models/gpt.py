@@ -904,6 +904,41 @@ class GptDecoder:
         head = dequantize_leaf(head, jnp.float32)
         return xn @ head.T
 
+    def stage_params(self, params: dict, first: int, last: int) -> dict:
+        """The param subtree one contiguous pipeline stage of layers
+        [first, last) needs (runtime/paged.py pp_stages=): its slice
+        of the stacked block params, plus the embedding tables when it
+        holds layer 0 (`_embed_tokens` inputs) and the final norm +
+        (tied) head when it holds the last layer (`_final_logits`
+        inputs). Slices are views of the same device buffers until a
+        stage placement copies them — the layer axis leads every stack
+        leaf, so one tree_map covers float and quantized trees
+        alike."""
+        L = self.cfg.num_layers
+        if not (0 <= first < last <= L):
+            raise ValueError(
+                f"stage layer range [{first}, {last}) out of bounds "
+                f"for {L} layers"
+            )
+        out: dict = {
+            "stack": jax.tree_util.tree_map(
+                lambda a: a[first:last], params["stack"]
+            )
+        }
+        if first == 0:
+            out["token_embedding"] = params["token_embedding"]
+            if "pos_embedding" in params:
+                out["pos_embedding"] = params["pos_embedding"]
+        if last == L:
+            out["final_ln_scale"] = params["final_ln_scale"]
+            if "final_ln_bias" in params:
+                out["final_ln_bias"] = params["final_ln_bias"]
+            if "lm_head" in params:
+                out["lm_head"] = params["lm_head"]
+            else:
+                out["token_embedding"] = params["token_embedding"]
+        return out
+
     def _memo_key(self, donate: bool):
         """Memo key for make_step; subclasses extend it when the
         compiled step depends on more than the donate flag."""

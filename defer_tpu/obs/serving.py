@@ -252,6 +252,52 @@ class ServingMetrics:
             "DFA reached a state admitting no token — compiled DFAs "
             "are dead-end-free by construction", labels,
         )
+        # Pipeline-parallel serving (runtime/paged.py pp_stages=):
+        # schedule-level health of the staged decode loop. Bubble is
+        # 1 - mean stage occupancy over the realized dispatch
+        # schedule (fill/drain slots plus any group that froze
+        # mid-window), NOT the closed-form (S-1)/(S-1+M*W). The
+        # per-stage instruments live behind bind_pp() because their
+        # label set depends on the stage count.
+        self.pp_bubble_fraction = reg.gauge(
+            "defer_pp_bubble_fraction",
+            "1 - mean stage occupancy of the most recent pipelined "
+            "decode window (0 on pp_stages=1 servers)", labels,
+        )
+        self.pp_inflight = reg.gauge(
+            "defer_pp_inflight_microbatches",
+            "Microbatch slot groups in flight through the stage "
+            "chain (M; 0 on pp_stages=1 servers)", labels,
+        )
+        self.pp_stage_occupancy: list = []
+        self.pp_stage_dispatches: list = []
+
+    def bind_pp(self, num_stages: int) -> None:
+        """Resolve the per-stage pipeline instruments (stage-labeled,
+        so the label set depends on the server's stage count — the
+        FleetMetrics per-replica idiom). Idempotent: the registry
+        get-or-creates, so two servers with the same stage count share
+        handles."""
+        reg = self.registry
+        per = [{"stage": str(s)} for s in range(num_stages)]
+        self.pp_stage_occupancy = [
+            reg.gauge(
+                "defer_pp_stage_occupancy",
+                "Fraction of the realized window schedule's dispatch "
+                "slots this stage spent busy (per stage)",
+                lab,
+            )
+            for lab in per
+        ]
+        self.pp_stage_dispatches = [
+            reg.counter(
+                "defer_pp_stage_dispatches_total",
+                "Stage-step dispatches issued to this pipeline stage "
+                "(one per microbatch per decode round)",
+                lab,
+            )
+            for lab in per
+        ]
 
 
 class DisaggMetrics:

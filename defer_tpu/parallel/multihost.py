@@ -120,3 +120,42 @@ def make_multihost_mesh(
 ):
     """make_mesh with the DCN-aware axis ordering applied."""
     return make_mesh(dcn_aware_axes(axes, dcn_axes=dcn_axes))
+
+
+def stage_submeshes(mesh, stage_axis: str = "stage") -> list:
+    """Split a mesh carrying a pipeline `stage_axis` into one submesh
+    per stage, each over that stage's device slice with the remaining
+    axes preserved in order.
+
+    This is how `pp_stages` composes around tensor parallelism
+    (runtime/paged.py): build the joint mesh with
+    `make_multihost_mesh({"stage": S, model_axis: tp})` — the
+    DCN-aware ordering puts `stage` outermost, so each stage's devices
+    are host-contiguous and its inner model-axis collectives stay on
+    ICI — then each pipeline stage runs its shard_map programs on its
+    own submesh while activations hop stage boundaries as replicated
+    arrays.
+    """
+    from jax.sharding import Mesh
+
+    if stage_axis not in mesh.axis_names:
+        raise ValueError(
+            f"mesh has no {stage_axis!r} axis (axes: {mesh.axis_names}); "
+            f"build it with make_multihost_mesh({{{stage_axis!r}: S, "
+            "...}})"
+        )
+    idx = mesh.axis_names.index(stage_axis)
+    if idx != 0:
+        raise ValueError(
+            f"{stage_axis!r} must be the OUTERMOST mesh axis so each "
+            "stage's devices are contiguous (dcn_aware_axes puts it "
+            f"there); got axis order {mesh.axis_names}"
+        )
+    rest = tuple(
+        n for n in mesh.axis_names if n != stage_axis
+    )
+    subs = []
+    for s in range(mesh.devices.shape[idx]):
+        devs = mesh.devices[s]
+        subs.append(Mesh(devs, rest))
+    return subs

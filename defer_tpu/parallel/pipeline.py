@@ -54,6 +54,79 @@ def cast_params_to_storage(params: Any, config: DeferConfig) -> Any:
     )
 
 
+def probe_latency(fn: Any, *args: Any, iters: int = 10) -> dict[str, Any]:
+    """Synchronous latency sample for one compiled callable — the
+    timing core `Pipeline.probe_stage_latencies` reports per stage,
+    extracted so other stage chains (the paged server's pp layer
+    probe) measure with identical methodology. Runs one untimed call
+    first (compile), then `iters` hard-synced calls for the p50, then
+    one amortized window (dispatch `iters`, one barrier)."""
+    hard_sync(fn(*args))  # ensure compiled
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        hard_sync(out)
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    t0 = time.perf_counter()
+    outs = [fn(*args) for _ in range(iters)]
+    hard_sync(outs[-1])
+    amortized = (time.perf_counter() - t0) / iters
+    return {
+        "p50_s": times[len(times) // 2],
+        "p99_s": times[int(len(times) * 0.99)] if len(times) >= 100 else None,
+        "max_s": times[-1],
+        "min_s": times[0],
+        "amortized_s": amortized,
+    }
+
+
+def balance_stage_cuts(costs: Sequence[float], num_stages: int) -> list[int]:
+    """Contiguous min-max partition of per-layer costs into
+    `num_stages` stages: returns the stage START indices
+    (cuts[0] == 0), chosen so the most expensive stage is as cheap as
+    possible. Exact O(L^2 * S) DP — layer counts are tens, not
+    thousands. Every stage is non-empty, so num_stages must not
+    exceed len(costs)."""
+    L = len(costs)
+    if num_stages < 1:
+        raise ValueError(f"num_stages must be >= 1, got {num_stages}")
+    if num_stages > L:
+        raise ValueError(
+            f"cannot split {L} layers into {num_stages} non-empty "
+            "stages"
+        )
+    prefix = [0.0]
+    for c in costs:
+        prefix.append(prefix[-1] + float(c))
+
+    def span(i: int, j: int) -> float:
+        return prefix[j] - prefix[i]
+
+    # best[s][j] = minimal max-stage-cost splitting costs[:j] into s
+    # stages; cut[s][j] = start of the last stage in that optimum.
+    INF = float("inf")
+    best = [[INF] * (L + 1) for _ in range(num_stages + 1)]
+    cut = [[0] * (L + 1) for _ in range(num_stages + 1)]
+    best[0][0] = 0.0
+    for s in range(1, num_stages + 1):
+        for j in range(s, L + 1):
+            for i in range(s - 1, j):
+                cand = max(best[s - 1][i], span(i, j))
+                if cand < best[s][j]:
+                    best[s][j] = cand
+                    cut[s][j] = i
+    starts: list[int] = []
+    j = L
+    for s in range(num_stages, 0, -1):
+        i = cut[s][j]
+        starts.append(i)
+        j = i
+    starts.reverse()
+    return starts
+
+
 class StreamMeasure:
     """Shared warmup/throughput for anything with __call__ + stream
     (Pipeline, ShardedInference, ReplicatedPipeline) — one definition
@@ -229,35 +302,13 @@ class Pipeline(StreamMeasure):
             if i > 0:
                 h = self._place(h, self.devices[i])
                 hard_sync(h)
-            hard_sync(fn(p, h))  # ensure compiled
-            times = []
-            for _ in range(iters):
-                t0 = time.perf_counter()
-                out = fn(p, h)
-                hard_sync(out)
-                times.append(time.perf_counter() - t0)
-            times.sort()
-            # Amortized per-call time: dispatch a window, one barrier.
-            # Excludes the per-call host sync round trip, which on
-            # tunneled transports dwarfs the stage itself.
-            t0 = time.perf_counter()
-            outs = [fn(p, h) for _ in range(iters)]
-            hard_sync(outs[-1])
-            amortized = (time.perf_counter() - t0) / iters
+            # Amortized half excludes the per-call host sync round
+            # trip, which on tunneled transports dwarfs the stage
+            # itself (probe_latency docstring has the methodology).
+            sample = probe_latency(fn, p, h, iters=iters)
+            amortized = sample["amortized_s"]
             results.append(
-                {
-                    "stage": i,
-                    "device": str(self.devices[i]),
-                    "p50_s": times[len(times) // 2],
-                    "p99_s": (
-                        times[int(len(times) * 0.99)]
-                        if len(times) >= 100
-                        else None
-                    ),
-                    "max_s": times[-1],
-                    "min_s": times[0],
-                    "amortized_s": amortized,
-                }
+                {"stage": i, "device": str(self.devices[i]), **sample}
             )
             # Cold path: registry lookup per probe is fine here.
             reg = get_registry()
@@ -271,6 +322,6 @@ class Pipeline(StreamMeasure):
                 "defer_stage_p50_seconds",
                 "Synchronous p50 stage latency (last probe)",
                 labels,
-            ).set(times[len(times) // 2])
+            ).set(sample["p50_s"])
             h = fn(p, h)
         return results

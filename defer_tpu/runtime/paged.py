@@ -92,7 +92,12 @@ from defer_tpu.models.quant import (
 )
 from defer_tpu.obs.serving import ServerStats, ServingMetrics
 from defer_tpu.ops.pallas_attention import _MASK_VALUE
-from defer_tpu.runtime.batching import accept_lengths, window_drain_order
+from defer_tpu.runtime.batching import (
+    accept_lengths,
+    microbatch_groups,
+    pp_schedule_occupancy,
+    window_drain_order,
+)
 from defer_tpu.runtime.decode_server import DraftLanes, SlotSampler
 from defer_tpu.runtime.stopping import matcher_or_none, normalize_stops
 
@@ -583,6 +588,351 @@ class PrefixBlockCache:
         return len(self.by_key)
 
 
+# -- pipeline-parallel stages (PagedDecodeServer pp_stages=) ---------------
+
+
+def _pp_stage_step(dec, bs, attention, first, last, tp_axis):
+    """RAW per-stage multi-token paged step for pipeline-parallel
+    serving: `_mt_body`'s computation restricted to the contiguous
+    layer range [first, last). The first stage embeds token ids, every
+    other stage takes the previous stage's [B, T, D] activations; the
+    last stage ends in the final norm + head (vocab slices all_gather
+    to replicated logits under tp, exactly like _replicate_logits).
+    Every stage recomputes the same write destinations from the
+    replicated tables/pos operands, so each one scatters its layers'
+    K/V rows into ITS OWN pool slice — the pool never crosses a stage
+    boundary, only the [B, T, D] activation does.
+
+    step(params_stage, pk, pv, tables, pos, xin, n_keep, keep_from,
+    adapter_ids) -> (x_or_logits, pk, pv); decode rounds ride it at
+    T=1 / n_keep=1 / keep_from=0, chunked pool-native prefill at
+    T=chunk — one compiled program per (stage, shape), exactly the
+    jit-cache behaviour the monolithic _mt has."""
+    window = dec.cfg.window
+    L = dec.cfg.num_layers
+    tp = tp_axis
+    if attention == "pallas":
+        from defer_tpu.models.gpt import _flash_decode_mode
+        from defer_tpu.ops.pallas_attention import paged_flash_prefill
+
+        interpret = _flash_decode_mode() != "tpu"
+
+    def step(
+        params, pk, pv, tables, pos, xin, n_keep, keep_from,
+        adapter_ids,
+    ):
+        b, t = xin.shape[0], xin.shape[1]
+        mb = tables.shape[1]
+        rows = jnp.arange(b)
+        steps_t = jnp.arange(t)
+        pvec = pos[:, None] + steps_t[None, :]  # [B, T]
+        # Write destinations: identical math to _mt_body — dropped
+        # rows (pad tails, radix-hit positions, frozen slots' zeroed
+        # tables) redirect to trash block 0.
+        blk = tables[
+            rows[:, None], jnp.minimum(pvec // bs, mb - 1)
+        ]
+        keep = (steps_t[None, :] < n_keep[:, None]) & (
+            pvec >= keep_from[:, None]
+        )
+        dest = jnp.where(keep, blk, 0)
+        rowi = pvec % bs
+        x = (
+            dec._embed_tokens(params, xin, pos, tp)
+            if first == 0
+            else xin
+        )
+
+        if attention == "gathered":
+
+            def body(carry, layer):
+                x = carry
+                p, pk_l, pv_l = layer
+                kc = _pool_gather(pk_l, tables, dec.compute_dtype)
+                vc = _pool_gather(pv_l, tables, dec.compute_dtype)
+                b_, mb_, hkv, _, dh = kc.shape
+                kc = kc.transpose(0, 2, 1, 3, 4).reshape(
+                    b_, hkv, mb_ * bs, dh
+                )
+                vc = vc.transpose(0, 2, 1, 3, 4).reshape(
+                    b_, hkv, mb_ * bs, dh
+                )
+                out, kc, vc = dec._block(
+                    p, x, kc, vc, pos, tp_axis=tp,
+                    adapter_ids=adapter_ids,
+                )
+                new_k = kc[rows[:, None], :, pvec, :]
+                new_v = vc[rows[:, None], :, pvec, :]
+                pk_l = _pool_write_rows_mt(pk_l, dest, rowi, new_k)
+                pv_l = _pool_write_rows_mt(pv_l, dest, rowi, new_v)
+                return out, (pk_l, pv_l)
+
+        elif attention == "blockwise":
+
+            def body(carry, layer):
+                x = carry
+                p, pk_l, pv_l = layer
+                q, k_new, v_new = dec._attn_qkv(
+                    p, x, pos, adapter_ids=adapter_ids
+                )
+                pk_l = _pool_write_rows_mt(
+                    pk_l, dest, rowi, k_new.transpose(0, 2, 1, 3)
+                )
+                pv_l = _pool_write_rows_mt(
+                    pv_l, dest, rowi, v_new.transpose(0, 2, 1, 3)
+                )
+                nb_live = jnp.minimum(
+                    (jnp.max(pos) + t - 1) // bs + 1, mb
+                )
+                attn = _blockwise_attend_mt(
+                    q, pk_l, pv_l, tables, pos, bs, nb_live,
+                    window,
+                )
+                out = dec._attn_out(
+                    p, x, attn, tp, adapter_ids=adapter_ids
+                )
+                return out, (pk_l, pv_l)
+
+        else:  # pallas
+
+            def body(carry, layer):
+                x = carry
+                p, pk_l, pv_l = layer
+                q, k_new, v_new = dec._attn_qkv(
+                    p, x, pos, adapter_ids=adapter_ids
+                )
+                pk_l = _pool_write_rows_mt(
+                    pk_l, dest, rowi, k_new.transpose(0, 2, 1, 3)
+                )
+                pv_l = _pool_write_rows_mt(
+                    pv_l, dest, rowi, v_new.transpose(0, 2, 1, 3)
+                )
+                b_, hq, t_, dh = q.shape
+                attn = paged_flash_prefill(
+                    q,
+                    _pool_arr(pk_l),
+                    _pool_arr(pv_l),
+                    tables,
+                    pos,
+                    window=window,
+                    interpret=interpret,
+                )
+                attn = (
+                    attn.transpose(0, 2, 1, 3)
+                    .reshape(b_, t_, hq * dh)
+                    .astype(x.dtype)
+                )
+                out = dec._attn_out(
+                    p, x, attn, tp, adapter_ids=adapter_ids
+                )
+                return out, (pk_l, pv_l)
+
+        x, (pk, pv) = lax.scan(body, x, (params["stack"], pk, pv))
+        if last == L:
+            logits = dec._final_logits(params, x)
+            if tp is not None:
+                logits = lax.all_gather(
+                    logits, tp, axis=-1, tiled=True
+                )[..., : dec.cfg.vocab_size]
+            return logits, pk, pv
+        return x, pk, pv
+
+    return step
+
+
+def _pp_stage_specs(full_specs: dict, first: int, last: int, cfg) -> dict:
+    """The shard_map in_specs subtree matching
+    GptDecoder.stage_params(params, first, last): stack leaf specs are
+    layer-leading (slicing the layer axis never changes them), the
+    boundary stages add the embedding / final-norm / tied-head specs
+    their extra params carry."""
+    out = {"stack": full_specs["stack"]}
+    if first == 0:
+        out["token_embedding"] = full_specs["token_embedding"]
+        if "pos_embedding" in full_specs:
+            out["pos_embedding"] = full_specs["pos_embedding"]
+    if last == cfg.num_layers:
+        out["final_ln_scale"] = full_specs["final_ln_scale"]
+        if "final_ln_bias" in full_specs:
+            out["final_ln_bias"] = full_specs["final_ln_bias"]
+        if "token_embedding" not in out:
+            out["token_embedding"] = full_specs["token_embedding"]
+    return out
+
+
+class _PPLocalStage:
+    """One pipeline stage resident in this process: the stage's param
+    slice (GptDecoder.stage_params) and its [last-first, num_blocks,
+    kv_heads, block_size, Dh] slice of the paged KV pool, placed
+    together on one device (the in-process device-to-device tier) or
+    one tensor-parallel submesh (pp x tp: the submesh is one slice of
+    the joint {stage, model} mesh, so the stage's psums stay on its
+    own ICI ring). `pp_dispatch` is the stage-boundary interface both
+    placements share with _PPTransportStage: feed the six replicated
+    operands, get the boundary activation (or final logits) back — an
+    ASYNC device future here, which is what lets the server's
+    round-major loop keep M microbatches in flight."""
+
+    def __init__(
+        self, dec, params, first, last, *, num_blocks, block_size,
+        attention, device=None, submesh=None, model_axis="model",
+    ):
+        from defer_tpu.utils.memo import cached_step
+
+        self.first = first
+        self.last = last
+        self.device = device
+        self.submesh = submesh
+        self.model_axis = model_axis if submesh is not None else None
+        cfg = dec.cfg
+        dh = cfg.dim // cfg.num_heads
+        pool_shape = (
+            last - first, num_blocks, cfg.kv_heads, block_size, dh,
+        )
+        if submesh is not None:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as PSpec
+
+            from defer_tpu.models.gpt import SpmdGptDecoder
+
+            sdec = cached_step(
+                dec,
+                ("pp_spmd_view", submesh, model_axis),
+                lambda: SpmdGptDecoder(
+                    cfg,
+                    compute_dtype=dec.compute_dtype,
+                    mesh=submesh,
+                    tp_axis=model_axis,
+                ),
+            )
+            # Full params placed on THIS submesh (vocab pad + int8
+            # bookkeeping), then sliced: the stack slices are fresh
+            # per-stage buffers, the boundary tables alias the
+            # placement.
+            self.params = dec.stage_params(
+                sdec.shard_params(params), first, last
+            )
+            self._param_specs = _pp_stage_specs(
+                sdec._specs(), first, last, cfg
+            )
+            self._pool_spec = PSpec(None, None, model_axis, None, None)
+            pool_sh = NamedSharding(submesh, self._pool_spec)
+            self.pk = jnp.zeros(
+                pool_shape, dec.compute_dtype, device=pool_sh
+            )
+            self.pv = jnp.zeros(
+                pool_shape, dec.compute_dtype, device=pool_sh
+            )
+            self._sink = NamedSharding(submesh, PSpec())
+        else:
+            sp = dec.stage_params(params, first, last)
+            if device is not None:
+                sp = jax.device_put(sp, device)
+            self.params = sp
+            self._param_specs = None
+            self._pool_spec = None
+            self.pk = jnp.zeros(pool_shape, dec.compute_dtype)
+            self.pv = jnp.zeros(pool_shape, dec.compute_dtype)
+            if device is not None:
+                self.pk = jax.device_put(self.pk, device)
+                self.pv = jax.device_put(self.pv, device)
+            self._sink = device
+        self.pool_bytes = self.pk.nbytes + self.pv.nbytes
+        self._fn = cached_step(
+            dec,
+            (
+                "paged_pp_stage", block_size, attention, first, last,
+                device, submesh, self.model_axis,
+            ),
+            lambda: self._build_fn(dec, block_size, attention),
+        )
+
+    def _build_fn(self, dec, bs, attention):
+        body = _pp_stage_step(
+            dec, bs, attention, self.first, self.last, self.model_axis
+        )
+        if self.submesh is None:
+            return jax.jit(body, donate_argnums=(1, 2))
+        from jax.sharding import PartitionSpec as PSpec
+
+        from defer_tpu.utils.compat import shard_map
+
+        pool, r = self._pool_spec, PSpec()
+        sm = shard_map(
+            body,
+            self.submesh,
+            in_specs=(self._param_specs, pool, pool) + (r,) * 6,
+            out_specs=(r, pool, pool),
+            # analysis: ignore[shard-spec] same waiver as _jit_tick: the body ends in slot scatters (and, on the last stage, a tiled all_gather) whose replication the checker cannot infer; psum placement is pinned by the defer_tp_psum_total mirror
+            check_rep=False,
+        )
+        return jax.jit(sm, donate_argnums=(1, 2))
+
+    def _put(self, a):
+        """Commit an operand to this stage's placement — the
+        in-process activation handoff (device-to-device copy; async,
+        so chained stage dispatches overlap)."""
+        if self._sink is None:
+            return jnp.asarray(a)
+        return jax.device_put(a, self._sink)
+
+    def pp_dispatch(self, tables, pos, xin, n_keep, keep_from,
+                    adapter_ids):
+        out, self.pk, self.pv = self._fn(
+            self.params,
+            self.pk,
+            self.pv,
+            self._put(tables),
+            self._put(pos),
+            self._put(xin),
+            self._put(n_keep),
+            self._put(keep_from),
+            self._put(adapter_ids),
+        )
+        return out
+
+    def close(self):  # interface symmetry with _PPTransportStage
+        pass
+
+
+class _PPTransportStage:
+    """A pipeline stage served by ANOTHER process over the framed
+    activation transport (runtime/transport.py): `pp_dispatch` ships
+    the six operands through an ArraySender to the stage worker
+    (runtime/remote_stage.py::serve_pp_stage, which wraps a
+    _PPLocalStage) and blocks on its one result array from the paired
+    ArrayReceiver. The round trip is SYNCHRONOUS per dispatch — this
+    placement is the cross-host parity/placement tier (same
+    serve_stage session shape remote_stage.py uses), not an overlap
+    win; in-process stages keep pipelining around it.
+
+    `spec` is (host, port, result_receiver): the worker's listen
+    address plus the caller-owned ArrayReceiver its results arrive
+    on."""
+
+    def __init__(self, spec, *, first, last, pool_bytes=0):
+        from defer_tpu.runtime.transport import ArraySender
+
+        host, port, receiver = spec
+        self.first = first
+        self.last = last
+        self.pool_bytes = pool_bytes
+        self._send = ArraySender(host, port)
+        self._recv = receiver
+        self._it = iter(receiver)
+
+    def pp_dispatch(self, tables, pos, xin, n_keep, keep_from,
+                    adapter_ids):
+        for a in (tables, pos, xin, n_keep, keep_from, adapter_ids):
+            # analysis: ignore[host-sync-in-hot-loop] the stage boundary IS a host transport here — framing the operand synchronizes it by design (documented parity tier)
+            self._send.send(np.asarray(a))
+        return next(self._it)
+
+    def close(self):
+        """Send the transport STOP so the worker's serve loop exits."""
+        self._send.close()
+
+
 class PagedDecodeServer:
     """Continuous batching over a paged KV pool; greedy by default,
     per-request sampling via `submit(..., sampling=)`.
@@ -625,6 +975,13 @@ class PagedDecodeServer:
         model_axis: str = "model",
         device: Any = None,
         constraints: dict | None = None,
+        pp_stages: int = 1,
+        pp_inflight: int | None = None,
+        pp_cuts: Any = None,
+        pp_devices: Any = None,
+        pp_remote: dict | None = None,
+        pp_balance: str = "equal",
+        pp_stage_axis: str = "stage",
     ):
         """`on_token(request_id, token_id, done)` — optional streaming
         callback, same contract as the flat server's.
@@ -641,6 +998,35 @@ class PagedDecodeServer:
         `eos_id` (a satisfied constraint must be able to stop). With
         the default None every traced program is byte-identical to a
         server built before this feature existed.
+
+        `pp_stages` — PIPELINE-PARALLEL serving (ARCHITECTURE.md
+        "Pipeline-parallel serving"): partition the decoder's layer
+        stack into S contiguous stages, each owning ONLY its layers'
+        slice of the paged KV block pool (per-stage HBM ~1/S; one
+        shared block table / free list indexes every slice), and run
+        the decode tick as a pipelined window — `pp_inflight` (M,
+        default min(S, max_batch)) microbatch slot groups flow through
+        the stage chain round-major with overlapped async dispatch, so
+        the schedule's bubble fraction is (S-1)/(K*M + S-1) and is
+        MEASURED per window (defer_pp_bubble_fraction), never assumed.
+        Greedy output is token-identical to pp_stages=1 across
+        attention modes x prefix_cache x decode_window x tp. Stage
+        boundaries are activation handoffs behind one interface with
+        two placements: in-process device-to-device (stage i on
+        `pp_devices[i]`, default jax.devices()), or the framed
+        transport for stages served by another process (`pp_remote`,
+        runtime/remote_stage.py::serve_pp_stage). With `mesh=` the
+        mesh must carry `pp_stage_axis` OUTERMOST around `model_axis`
+        (parallel/multihost.py::make_multihost_mesh puts it there), and
+        each stage runs tensor-parallel on its own submesh. `pp_cuts`
+        pins explicit stage start layers; `pp_balance="probe"`
+        auto-balances cuts by per-layer probe cost
+        (parallel/pipeline.py::balance_stage_cuts). Admission prefill
+        always runs pool-native through the stage chain (chunked by
+        `prefill_chunk` when set). Deferred compositions raise with
+        the fix spelled out: spec_k > 0, disagg ingest
+        (submit_prefilled/deliver_kv), constraints, multi-LoRA,
+        constructor prefix_ids, spill_bytes, kv_dtype="int8".
 
         `spec_k` — speculative decoding (ARCHITECTURE.md "Speculative
         serving"): a DRAFT decoder (`spec_draft`/`spec_params`, same
@@ -818,6 +1204,102 @@ class PagedDecodeServer:
                 "mesh= and device= are mutually exclusive: a mesh "
                 "already pins the server to its devices"
             )
+        if pp_stages < 1:
+            raise ValueError(f"pp_stages must be >= 1, got {pp_stages}")
+        self.pp = pp_stages
+        if pp_stages == 1 and (
+            pp_inflight is not None
+            or pp_cuts is not None
+            or pp_devices is not None
+            or pp_remote is not None
+        ):
+            raise ValueError(
+                "pp_inflight/pp_cuts/pp_devices/pp_remote only apply "
+                "with pp_stages > 1"
+            )
+        _pp_M = 1
+        if pp_stages > 1:
+            if pp_stages > dec.cfg.num_layers:
+                raise ValueError(
+                    f"pp_stages={pp_stages} exceeds num_layers="
+                    f"{dec.cfg.num_layers}: every stage needs at least "
+                    "one layer. Fix: lower pp_stages (or serve a "
+                    "deeper model)."
+                )
+            if spec_k:
+                raise ValueError(
+                    "spec_k > 0 does not compose with pp_stages > 1 "
+                    "yet: the draft lane proposes against a monolithic "
+                    "pool and the verify forward would have to thread "
+                    "k+1 candidate rows through every stage boundary. "
+                    "Fix: serve speculation on a pp_stages=1 server, "
+                    "or set spec_k=0 here."
+                )
+            if constraints is not None:
+                raise ValueError(
+                    "constraints= does not compose with pp_stages > 1 "
+                    "yet: the DFA advance is fused into the monolithic "
+                    "window program. Fix: serve constrained requests "
+                    "on a pp_stages=1 server."
+                )
+            if self.multi_lora:
+                raise ValueError(
+                    "multi-LoRA does not compose with pp_stages > 1: "
+                    "adapter banks are not stage-sliced. Fix: merge "
+                    "the adapter (parallel/lora.py) or serve adapters "
+                    "on pp_stages=1."
+                )
+            if prefix_ids is not None:
+                raise ValueError(
+                    "constructor prefix_ids does not compose with "
+                    "pp_stages > 1: the one-shot prefix insert runs "
+                    "through the monolithic flat path. Fix: use "
+                    "prefix_cache=True (shares prefixes per request, "
+                    "pool-native) instead."
+                )
+            if spill_bytes:
+                raise ValueError(
+                    "spill_bytes > 0 does not compose with "
+                    "pp_stages > 1 yet: spill snapshots slice a "
+                    "monolithic pool. Fix: set spill_bytes=0 (evicted "
+                    "prefix blocks are then re-prefilled)."
+                )
+            if kv_dtype != "fp":
+                raise ValueError(
+                    f"kv_dtype={kv_dtype!r} does not compose with "
+                    "pp_stages > 1 yet: the per-stage pool slices are "
+                    "compute-dtype only. Fix: use kv_dtype='fp' with "
+                    "pp, or int8 on a pp_stages=1 server."
+                )
+            if device is not None:
+                raise ValueError(
+                    "device= pins ONE device but pp_stages > 1 places "
+                    "each stage on its own. Fix: pass the stage "
+                    "placement as pp_devices=[dev0, dev1, ...] "
+                    "instead."
+                )
+            if pp_balance not in ("equal", "probe"):
+                raise ValueError(
+                    f"pp_balance must be 'equal' or 'probe', got "
+                    f"{pp_balance!r}"
+                )
+            _pp_M = (
+                pp_inflight
+                if pp_inflight is not None
+                else min(pp_stages, max_batch)
+            )
+            if _pp_M < 1:
+                raise ValueError(
+                    f"pp_inflight must be >= 1, got {_pp_M}"
+                )
+            if max_batch % _pp_M:
+                raise ValueError(
+                    f"max_batch={max_batch} does not divide into "
+                    f"pp_inflight={_pp_M} equal microbatch slot "
+                    "groups. Fix: pick max_batch a multiple of "
+                    "pp_inflight (or pass pp_inflight= a divisor of "
+                    "max_batch)."
+                )
         self.mesh = mesh
         self.model_axis = model_axis
         self.device = device
@@ -869,6 +1351,33 @@ class PagedDecodeServer:
                     "mesh=None"
                 )
             self.tp = tp
+            if pp_stages > 1:
+                # pp x tp: the joint mesh carries the stage axis
+                # OUTERMOST (DCN-crossing, one activation per
+                # boundary) around the model axis (ICI-heavy psums
+                # stay inside a stage's submesh) — the
+                # make_multihost_mesh/dcn_aware_axes layout rule.
+                from defer_tpu.parallel.multihost import stage_submeshes
+
+                if pp_stage_axis not in mesh.axis_names:
+                    raise ValueError(
+                        f"pp_stages={pp_stages} with mesh= needs a "
+                        f"{pp_stage_axis!r} mesh axis for the stage "
+                        f"dimension (axes: {mesh.axis_names}). Fix: "
+                        "build the mesh with parallel.multihost."
+                        f"make_multihost_mesh({{{pp_stage_axis!r}: "
+                        f"{pp_stages}, {model_axis!r}: tp}})."
+                    )
+                if int(mesh.shape[pp_stage_axis]) != pp_stages:
+                    raise ValueError(
+                        f"mesh {pp_stage_axis!r} axis has size "
+                        f"{int(mesh.shape[pp_stage_axis])} but "
+                        f"pp_stages={pp_stages}; the two must match"
+                    )
+                self._pp_submeshes = stage_submeshes(
+                    mesh, pp_stage_axis
+                )
+        if mesh is not None and pp_stages == 1:
             # One sharded view of the decoder per (dec, mesh, axis):
             # SpmdGptDecoder supplies the param specs, vocab padding,
             # sharded flat prefill step, and the remaining divisibility
@@ -921,7 +1430,17 @@ class PagedDecodeServer:
         # holds. The fp pool stays a PLAIN array — its jitted
         # programs trace byte-identical to pre-int8 builds.
         scale_shape = (cfg.num_layers, num_blocks, cfg.kv_heads)
-        if mesh is not None:
+        if self.pp > 1:
+            # Pipeline-parallel: the pool never exists monolithically
+            # — each _PPLocalStage allocates its own layer slice on
+            # its own placement (built below, after the bookkeeping
+            # state the cut probe needs). The None handles make any
+            # path that would touch a monolithic pool fail loudly.
+            self._pool_spec = None
+            self._head_spec = None
+            self.pool_k = None
+            self.pool_v = None
+        elif mesh is not None:
             from jax.sharding import NamedSharding
             from jax.sharding import PartitionSpec as PSpec
 
@@ -986,6 +1505,102 @@ class PagedDecodeServer:
             leaf.nbytes
             for leaf in jax.tree.leaves((self.pool_k, self.pool_v))
         )
+        # Pipeline-parallel stage chain (pp_stages > 1): resolve the
+        # layer cuts, build one stage per contiguous layer range, and
+        # account the pool as the sum of the per-stage slices.
+        self._pp_stage_objs: list = []
+        self._pp_cut_starts: list[int] = [0]
+        self._pp_inflight = _pp_M
+        self._pp_groups: list[list[int]] = []
+        self.pp_stage_pool_bytes: list[int] = []
+        self.pp_stage_dispatch_n: list[int] = []
+        self.pp_bubble_last = 0.0
+        self.pp_occupancy_last: list[float] = []
+        if self.pp > 1:
+            from defer_tpu.parallel.pipeline import balance_stage_cuts
+
+            L = cfg.num_layers
+            if pp_cuts is not None:
+                starts = [int(c) for c in pp_cuts]
+                if (
+                    len(starts) != self.pp
+                    or starts[0] != 0
+                    or any(
+                        b <= a for a, b in zip(starts, starts[1:])
+                    )
+                    or starts[-1] >= L
+                ):
+                    raise ValueError(
+                        f"pp_cuts={starts} must be {self.pp} strictly "
+                        f"increasing stage START layers beginning at 0 "
+                        f"and below num_layers={L} (e.g. [0, "
+                        f"{L // 2}] for 2 stages). Fix: pass valid "
+                        "cut starts, or drop pp_cuts for balanced "
+                        "ones."
+                    )
+            elif pp_balance == "probe":
+                starts = balance_stage_cuts(
+                    self._probe_pp_layer_costs(num_blocks), self.pp
+                )
+            else:
+                # Equal layer counts == min-max split of unit costs.
+                starts = balance_stage_cuts([1.0] * L, self.pp)
+            bounds = starts + [L]
+            remote = pp_remote or {}
+            if any(s not in range(self.pp) for s in remote):
+                raise ValueError(
+                    f"pp_remote stage indices {sorted(remote)} must "
+                    f"lie in [0, {self.pp})"
+                )
+            devs = (
+                list(pp_devices)
+                if pp_devices is not None
+                else jax.devices()
+            )
+            dh_ = cfg.dim // cfg.num_heads
+            itemsize = jnp.dtype(dec.compute_dtype).itemsize
+            for s in range(self.pp):
+                first_l, last_l = bounds[s], bounds[s + 1]
+                if s in remote:
+                    # The worker owns the slice; account its bytes
+                    # here so per-stage HBM ~1/S stays inspectable.
+                    stage = _PPTransportStage(
+                        remote[s],
+                        first=first_l,
+                        last=last_l,
+                        pool_bytes=2
+                        * (last_l - first_l)
+                        * num_blocks
+                        * cfg.kv_heads
+                        * block_size
+                        * dh_
+                        * itemsize,
+                    )
+                elif mesh is not None:
+                    stage = _PPLocalStage(
+                        dec, params, first_l, last_l,
+                        num_blocks=num_blocks,
+                        block_size=block_size,
+                        attention=attention,
+                        submesh=self._pp_submeshes[s],
+                        model_axis=model_axis,
+                    )
+                else:
+                    stage = _PPLocalStage(
+                        dec, params, first_l, last_l,
+                        num_blocks=num_blocks,
+                        block_size=block_size,
+                        attention=attention,
+                        device=devs[s % len(devs)],
+                    )
+                self._pp_stage_objs.append(stage)
+            self._pp_cut_starts = starts
+            self._pp_groups = microbatch_groups(max_batch, _pp_M)
+            self.pp_stage_pool_bytes = [
+                st.pool_bytes for st in self._pp_stage_objs
+            ]
+            self.pool_bytes = sum(self.pp_stage_pool_bytes)
+            self.pp_stage_dispatch_n = [0] * self.pp
         # Block 0 is trash: unallocated table entries point at it.
         self.free = list(range(1, num_blocks))
         self.tables = np.zeros((max_batch, self.MB), np.int32)
@@ -1021,6 +1636,11 @@ class PagedDecodeServer:
         # pre-bound attributes only (obs/serving.py).
         self.obs = ServingMetrics("paged", mesh_shape=self.mesh_label)
         self.obs.kv_pool_bytes.set(self.pool_bytes)
+        if self.pp > 1:
+            # Stage-labeled pp instruments (occupancy gauges + dispatch
+            # counters per stage) bind once the stage count is known.
+            self.obs.bind_pp(self.pp)
+            self.obs.pp_inflight.set(float(self._pp_inflight))
         self._submit_t: dict[int, float] = {}
         self._last_tick_t: float | None = None
         # Constrained decoding tables (defer_tpu/constrain/): stacked
@@ -1279,6 +1899,15 @@ class PagedDecodeServer:
         from the prompt ids — draft prefill is the cheap side of the
         asymmetry, so decode-worker speculation keeps the disagg
         split's point.)"""
+        if self.pp > 1:
+            raise ValueError(
+                "disagg ingest (submit_prefilled/deliver_kv) does not "
+                "compose with pp_stages > 1 yet: delivered KV blocks "
+                "target a monolithic pool, not per-stage slices. Fix: "
+                "point the prefill worker at a pp_stages=1 decode "
+                "server, or submit() so prefill runs through the "
+                "stage chain."
+            )
         if self.shared_blocks or self.prefix_len:
             raise ValueError(
                 "externally prefilled admission does not compose with "
@@ -1614,6 +2243,12 @@ class PagedDecodeServer:
     # -- internals --------------------------------------------------------
 
     def _build(self):
+        if self.pp > 1:
+            # Pipeline-parallel servers never run the monolithic tick
+            # /insert programs: every forward goes through the stage
+            # chain (_tick_pp / _prefill_paged), whose programs the
+            # stages own.
+            return
         if self._step is not None:
             return
         # Memoized ON THE DECODER (utils/memo.py): jit's cache is keyed
@@ -2944,8 +3579,15 @@ class PagedDecodeServer:
         Tail chunks pow2-pad, capped so the deepest write stays
         inside the table span (the gathered path's contiguous-lane
         write must never clamp)."""
-        mt = self._ensure_mt()
-        C = self.prefill_chunk
+        mt = self._ensure_mt() if self.pp == 1 else None
+        # pp admission is ALWAYS pool-native: with prefill_chunk unset
+        # the whole prompt rides one pow2-padded chunk through the
+        # stage chain (the cap below bounds it to the table span).
+        C = (
+            self.prefill_chunk
+            if self.prefill_chunk is not None
+            else self.MB * self.bs
+        )
         t0 = prompt.shape[1]
         tab = jnp.asarray(table_row[None, :].copy())
         adapter = jnp.full((1,), adapter_id, jnp.int32)
@@ -2964,21 +3606,38 @@ class PagedDecodeServer:
                     [chunk, jnp.zeros((1, pad_t - real), chunk.dtype)],
                     axis=1,
                 )
-            logits, self.pool_k, self.pool_v = mt(
-                self.params,
-                self.pool_k,
-                self.pool_v,
-                tab,
-                jnp.asarray([pos0], jnp.int32),
-                chunk.astype(jnp.int32),
-                jnp.asarray([real], jnp.int32),
-                kf,
-                adapter,
-            )
+            if self.pp > 1:
+                # The chunk flows through the stage chain; each stage
+                # scatters its own layers' K/V into its pool slice.
+                x = chunk.astype(jnp.int32)
+                pos_a = jnp.asarray([pos0], jnp.int32)
+                nk = jnp.asarray([real], jnp.int32)
+                for s, stage in enumerate(self._pp_stage_objs):
+                    x = stage.pp_dispatch(tab, pos_a, x, nk, kf, adapter)
+                    self.pp_stage_dispatch_n[s] += 1
+                    self.obs.pp_stage_dispatches[s].inc()
+                logits = x
+            else:
+                logits, self.pool_k, self.pool_v = mt(
+                    self.params,
+                    self.pool_k,
+                    self.pool_v,
+                    tab,
+                    jnp.asarray([pos0], jnp.int32),
+                    chunk.astype(jnp.int32),
+                    jnp.asarray([real], jnp.int32),
+                    kf,
+                    adapter,
+                )
             self._account_kv_rows_prefill(pos0, pad_t)
             self._account_psums(1)
             logits_row = logits[:, real - 1, :]
             start += real
+        if self.pp > 1:
+            # The sampler's state lives on the default device; commit
+            # the last stage's logits row there so admission-side
+            # first-token draws stay single-device (async transfer).
+            logits_row = jax.device_put(logits_row, jax.devices()[0])
         return logits_row
 
     def _account_kv_rows_prefill(self, pos0: int, t: int) -> None:
@@ -3167,7 +3826,7 @@ class PagedDecodeServer:
         suffix = prompt[:, suffix_pos:]
         ts = suffix.shape[1]
         self.obs.prefill_tokens.inc(ts)
-        if self.prefill_chunk is not None:
+        if self.prefill_chunk is not None or self.pp > 1:
             # Pool-native chunked prefill: the hit blocks are read
             # straight from the pool by the block-table attention (no
             # gather into a flat lane), fresh rows scatter into this
@@ -3560,7 +4219,7 @@ class PagedDecodeServer:
                 table_row[j] = blk
             for j, blk in enumerate(blocks):
                 table_row[n_shared + j] = blk
-            if self.prefill_chunk is not None:
+            if self.prefill_chunk is not None or self.pp > 1:
                 # Pool-native chunked prefill: rows land in the
                 # allocated blocks as each chunk computes, and a
                 # global shared prefix (base=P) is read from ITS pool
@@ -3657,6 +4316,8 @@ class PagedDecodeServer:
             )
 
     def _tick(self) -> None:
+        if self.pp > 1:
+            return self._tick_pp()
         if self.spec_k:
             if self.decode_window > 1:
                 return self._tick_spec_window()
@@ -4543,6 +5204,277 @@ class PagedDecodeServer:
         self._drain_window(toks, toks_host, emitted, alive_host,
                            budget, died_host, fracs_host)
 
+    def _probe_pp_layer_costs(self, num_blocks: int) -> list[float]:
+        """Per-layer amortized step cost for pp_balance="probe"
+        (parallel/pipeline.py::probe_latency methodology): each layer
+        is wrapped in a throwaway single-layer stage with a 2-block
+        pool and timed on a [1, 1] decode round. Boundary costs are
+        attributed honestly — layer 0 carries the embedding, the last
+        layer the final norm + head — so balance_stage_cuts sees the
+        work a stage would actually run."""
+        from defer_tpu.parallel.pipeline import probe_latency
+
+        cfg = self.dec.cfg
+        tab = jnp.zeros((1, self.MB), jnp.int32)
+        pos = jnp.zeros((1,), jnp.int32)
+        nk = jnp.ones((1,), jnp.int32)
+        kf = jnp.zeros((1,), jnp.int32)
+        ad = jnp.zeros((1,), jnp.int32)
+        ids = jnp.zeros((1, 1), jnp.int32)
+        act = jnp.zeros((1, 1, cfg.dim), self.dec.compute_dtype)
+        costs = []
+        for layer in range(cfg.num_layers):
+            stage = _PPLocalStage(
+                self.dec, self.params, layer, layer + 1,
+                num_blocks=2,
+                block_size=self.bs,
+                attention=self.attention,
+            )
+            xin = ids if layer == 0 else act
+            sample = probe_latency(
+                stage.pp_dispatch, tab, pos, xin, nk, kf, ad, iters=3
+            )
+            costs.append(sample["amortized_s"])
+        return costs
+
+    def _build_pp_ctl(self, mode: str):
+        """Jitted per-round controller for the pipelined decode loop:
+        the sample/advance/freeze tail of ONE _build_window sub-step,
+        lifted out of the stage programs so it runs once per
+        (round, group) on the last stage's output. The freeze math is
+        copied verbatim from the window body — same argmax/draw trio,
+        same budget/eos gating, same pos/table zeroing — which is what
+        pins pp greedy output token-identical to pp_stages=1."""
+        from defer_tpu.utils.memo import cached_step
+
+        eos = self.eos_id
+
+        def build():
+            def ctl(ll, keys, temp, topk, topp, minp, pos, n, active,
+                    budget, tables):
+                if mode == "argmax":
+                    nxt = jnp.argmax(ll, axis=-1)
+                elif mode == "nosort":
+                    nxt, keys = sample_token_batched_nosort(
+                        ll, keys, temp, minp
+                    )
+                else:
+                    nxt, keys = sample_token_batched(
+                        ll, keys, temp, topk, topp, minp
+                    )
+                adv = active.astype(jnp.int32)
+                pos = pos + adv
+                n = n + adv
+                alive = active & (n < budget)
+                if eos is not None:
+                    alive = alive & (nxt != eos)
+                feed = nxt[:, None].astype(jnp.int32)
+                pos_eff = jnp.where(alive, pos, 0)
+                tab_eff = jnp.where(alive[:, None], tables, 0)
+                return (
+                    nxt, keys, pos, n, alive, feed, pos_eff, tab_eff,
+                )
+
+            return jax.jit(ctl)
+
+        return cached_step(
+            self.dec, ("paged_pp_ctl", mode, eos), build
+        )
+
+    def _tick_pp(self) -> None:
+        """One PIPELINED decode window: decode_window rounds for each
+        of M in-flight microbatch slot groups, chained through the S
+        stages round-major (GPipe schedule). Every stage dispatch is
+        asynchronous — while stage s computes group g's round, the
+        host has already enqueued group g+1 on stage s-1 — so up to M
+        chains overlap in flight and only the drain at the bottom
+        synchronizes.
+
+        Occupancy is MEASURED at the schedule level, which is
+        placement-independent: dispatch (round k, group g) enters
+        stage s at slot k*M_live + g + s, each stage is busy for
+        `chains` of the span's `chains + S - 1` slots, and the bubble
+        fraction published per window is 1 - mean occupancy =
+        (S-1)/(K*M_live + S-1) — groups with no live slot at the
+        window boundary are skipped, which is what makes the number
+        measured rather than the closed form."""
+        live = [s is not None for s in self.slots]
+        if not any(live):
+            return
+        K = self.decode_window
+        S = self.pp
+        stages = self._pp_stage_objs
+        sm = self._sampler
+        sampling = any(
+            s is not None and s["sampling"] for s in self.slots
+        )
+        if not sampling:
+            mode = "argmax"
+        elif any(sm.row_sort):
+            mode = "sort"
+        else:
+            mode = "nosort"
+        budget = [
+            s["remaining"] if s is not None else 0
+            for s in self.slots
+        ]
+        posm = np.where(live, self.pos, 0).astype(np.int32)
+        ctl = self._build_pp_ctl(mode)
+        put = stages[-1]._put if hasattr(stages[-1], "_put") else jnp.asarray
+        groups = self._pp_groups
+        Bg = len(groups[0])
+        nk1 = jnp.ones((Bg,), jnp.int32)
+        kf0 = jnp.zeros((Bg,), jnp.int32)
+        # Per-group device state on the CONTROLLER placement (the last
+        # stage's): the same aliasing-copy rule as _tick_window for
+        # tables/adapter, the same host-side round-0 freeze masks the
+        # window body computes from its initial `active`.
+        st: list[dict | None] = [None] * len(groups)
+        for g, idx in enumerate(groups):
+            if not any(live[i] for i in idx):
+                continue
+            # analysis: ignore[host-sync-in-hot-loop] host index list
+            # (python ints), no device buffer crosses here
+            ia = np.asarray(idx)
+            # analysis: ignore[host-sync-in-hot-loop] host bool list
+            live_g = np.asarray([live[i] for i in idx])
+            tab_g = self.tables[ia].copy()
+            pos_g = posm[ia]
+            st[g] = {
+                "tables": put(tab_g),
+                "tab_eff": put(np.where(live_g[:, None], tab_g, 0)),
+                "pos": put(pos_g),
+                "pos_eff": put(np.where(live_g, pos_g, 0)),
+                "n": put(np.zeros(len(idx), np.int32)),
+                "active": put(live_g),
+                "budget": put(
+                    # analysis: ignore[host-sync-in-hot-loop] host ints
+                    np.asarray([budget[i] for i in idx], np.int32)
+                ),
+                "feed": put(self._feed[ia]),
+                "keys": put(sm.keys[ia]),
+                "temp": put(sm.temp[ia]),
+                "topk": put(sm.topk[ia]),
+                "topp": put(sm.topp[ia]),
+                "minp": put(sm.minp[ia]),
+                "adapter": put(self.adapter[ia].copy()),
+                "toks": [],
+            }
+        disp = self.obs.pp_stage_dispatches
+        chains = 0
+        for _k in range(K):
+            for g, state in enumerate(st):
+                if state is None:
+                    continue
+                x = state["feed"]
+                for s, stage in enumerate(stages):
+                    x = stage.pp_dispatch(
+                        state["tab_eff"], state["pos_eff"], x, nk1,
+                        kf0, state["adapter"],
+                    )
+                    self.pp_stage_dispatch_n[s] += 1
+                    disp[s].inc()
+                chains += 1
+                (nxt, keys, pos, n, alive, feed, pos_eff,
+                 tab_eff) = ctl(
+                    put(x[:, -1, :]), state["keys"], state["temp"],
+                    state["topk"], state["topp"], state["minp"],
+                    state["pos"], state["n"], state["active"],
+                    state["budget"], state["tables"],
+                )
+                state.update(
+                    keys=keys, pos=pos, n=n, active=alive, feed=feed,
+                    pos_eff=pos_eff, tab_eff=tab_eff,
+                )
+                state["toks"].append(nxt)
+        # Write the per-group sampler/feed state back to the full-B
+        # vectors on their home device (async device-to-device puts).
+        dev0 = jax.devices()[0]
+        for g, state in enumerate(st):
+            if state is None:
+                continue
+            ia = jnp.asarray(groups[g])
+            self._feed = self._feed.at[ia].set(
+                jax.device_put(state["feed"], dev0)
+            )
+            sm.keys = sm.keys.at[ia].set(
+                jax.device_put(state["keys"], dev0)
+            )
+        self.ticks += 1
+        self.dispatches += 1
+        n_live = sum(live)
+        now = time.perf_counter()
+        if self._last_tick_t is not None:
+            self.obs.itl.observe(now - self._last_tick_t, n_live)
+        self._last_tick_t = now
+        self.obs.ticks.inc()
+        self.obs.host_dispatches.inc()
+        # Every chain is one full forward spread over the S stages:
+        # its collectives sum to the same 2L+2 the monolithic sharded
+        # forward issues (psum mirror contract).
+        self._account_psums(chains)
+        occ, bubble = pp_schedule_occupancy(
+            [chains] * S, chains + S - 1
+        )
+        self.pp_occupancy_last = occ
+        self.pp_bubble_last = bubble
+        self.obs.pp_bubble_fraction.set(bubble)
+        for s, o in enumerate(occ):
+            self.obs.pp_stage_occupancy[s].set(o)
+        need_toks = self.on_token is not None or any(
+            s is not None and s["stop"] is not None
+            for s in self.slots
+        )
+        if self.eos_id is not None:
+            emitted: list[int] = []
+            alive_host: list[bool] = []
+            for g, idx in enumerate(groups):
+                if st[g] is None:
+                    emitted += [0] * len(idx)
+                    alive_host += [False] * len(idx)
+                    continue
+                # analysis: ignore[host-sync-in-hot-loop] one batched
+                # per-WINDOW transfer of the group's valid-length /
+                # alive vectors — K tokens amortize it, same waiver
+                # as _tick_window
+                emitted += np.asarray(st[g]["n"]).tolist()
+                # analysis: ignore[host-sync-in-hot-loop] same
+                # per-window sync point (ready with the vector above)
+                act_g = np.asarray(st[g]["active"]).tolist()
+                alive_host += [bool(a) for a in act_g]
+        else:
+            emitted = [min(b, K) for b in budget]
+            alive_host = [b > K for b in budget]
+        # Assemble the full-B [B, K] token buffer on the home device
+        # (groups are contiguous ascending index ranges, so group
+        # order IS slot order); skipped groups contribute zeros their
+        # emitted=0 drain never reads.
+        parts = []
+        for g, state in enumerate(st):
+            if state is None:
+                parts.append(jnp.zeros((Bg, K), jnp.int32))
+                continue
+            parts.append(
+                jax.device_put(
+                    jnp.stack(state["toks"], axis=1), dev0
+                ).astype(jnp.int32)
+            )
+        toks = jnp.concatenate(parts, axis=0)
+        # analysis: ignore[host-sync-in-hot-loop] the ONE batched
+        # [B, K] token transfer per window — only when a stream/stop
+        # consumer exists, same waiver as _tick_window
+        toks_host = np.asarray(toks).tolist() if need_toks else None
+        self._account_kv_rows_window(posm, emitted)
+        self._drain_window(toks, toks_host, emitted, alive_host,
+                           budget)
+
+    def close_pp(self) -> None:
+        """Release pipeline-stage resources: transport-placed stages
+        send their STOP frame so remote workers' serve loops exit
+        (in-process stages are no-ops)."""
+        for stage in self._pp_stage_objs:
+            stage.close()
+
     def _account_kv_rows_window(self, posm, emitted) -> None:
         """Windowed K/V-row accounting: the exact host-side mirror of
         what each attention path read across the window's K sub-steps
@@ -4734,6 +5666,12 @@ def serve_paged(
     mesh: Any = None,
     model_axis: str = "model",
     constraints: dict | None = None,
+    pp_stages: int = 1,
+    pp_inflight: int | None = None,
+    pp_cuts: Any = None,
+    pp_devices: Any = None,
+    pp_remote: dict | None = None,
+    pp_balance: str = "equal",
 ) -> tuple[list[jax.Array], dict]:
     """One-shot paged serving; returns (outputs in submission order,
     stats incl. peak pool usage). `adapter_ids` optionally assigns a
@@ -4771,7 +5709,15 @@ def serve_paged(
     `constraints={name: TokenDFA}` registers compiled grammars
     (defer_tpu/constrain/) that per-request SamplingParams can opt
     into via `constraint="name"`; stats then also carry
-    `constrained_tokens` / `constraint_dead_ends`."""
+    `constrained_tokens` / `constraint_dead_ends`.
+
+    `pp_stages=S` runs the server pipeline-parallel (PagedDecodeServer
+    docstring: staged layer stack, per-stage KV pool slices, M
+    in-flight microbatch groups). Greedy output is token-identical to
+    `pp_stages=1`; stats then also carry `pp_stages` / `pp_inflight` /
+    `pp_bubble_fraction` (measured, last window) /
+    `pp_stage_occupancy` / `pp_stage_dispatches` /
+    `pp_stage_pool_bytes`."""
     srv = PagedDecodeServer(
         dec,
         params,
@@ -4792,6 +5738,12 @@ def serve_paged(
         mesh=mesh,
         model_axis=model_axis,
         constraints=constraints,
+        pp_stages=pp_stages,
+        pp_inflight=pp_inflight,
+        pp_cuts=pp_cuts,
+        pp_devices=pp_devices,
+        pp_remote=pp_remote,
+        pp_balance=pp_balance,
     )
     aids = adapter_ids or [0] * len(requests)
     if len(aids) != len(requests):
@@ -4810,6 +5762,8 @@ def serve_paged(
         for (p, s), a, sp in zip(requests, aids, samps)
     ]
     done = srv.run()
+    if srv.pp > 1:
+        srv.close_pp()
     if srv._spill is not None:
         # Drain pending spill copies so the stats snapshot (and any
         # caller inspecting the store) sees a settled tier.
@@ -4856,5 +5810,12 @@ def serve_paged(
         ),
         constrained_tokens=srv.constrained_tokens_n,
         constraint_dead_ends=srv.constraint_dead_ends_n,
+        pp_stages=srv.pp,
+        pp_inflight=srv._pp_inflight if srv.pp > 1 else 0,
+        pp_bubble_fraction=srv.pp_bubble_last,
+        pp_stage_occupancy=list(srv.pp_occupancy_last),
+        pp_stage_dispatches=list(srv.pp_stage_dispatch_n),
+        pp_stage_pool_bytes=list(srv.pp_stage_pool_bytes),
+        pp_cut_starts=list(srv._pp_cut_starts),
     )
     return [done[r] for r in rids], stats

@@ -258,6 +258,80 @@ def serve_stage(
         recv.close()
 
 
+# analysis: domain(pp-stage-worker) the whole session — stage pools and
+# the result stream — is owned by this worker thread; the controller
+# only ever talks to it through the framed transport
+def serve_pp_stage(
+    dec: Any,
+    params: Any,
+    first: int,
+    last: int,
+    *,
+    num_blocks: int,
+    block_size: int,
+    attention: str = "gathered",
+    listen_port: int = 0,
+    result_host: str = "127.0.0.1",
+    result_port: int = 5000,
+    listen_host: str = "0.0.0.0",
+    accept_timeout_s: float = 120.0,
+    announce=None,
+) -> int:
+    """Serve ONE pipeline stage of a paged decode server
+    (PagedDecodeServer(pp_remote=...)) to a remote controller — the
+    decode-time sibling of `serve_stage`, same session shape, different
+    payload: each microbatch is the SIX stage-boundary operands
+    (tables, pos, xin, n_keep, keep_from, adapter_ids) and the reply is
+    the one boundary activation (or, on the last stage, logits) array.
+
+    The worker wraps the same `_PPLocalStage` the in-process tier uses
+    — its layer slice of the params and its own KV-pool slice live
+    here, so the controller's per-stage HBM claim holds across hosts
+    too. Unlike `serve_stage`, the stage definition is NOT shipped over
+    the wire: decoders aren't graph-serializable, so the worker process
+    is handed `(dec, params)` directly (tests run it in a thread;
+    cross-host drivers load the checkpoint themselves). Runs until the
+    controller's STOP frame; returns microbatches served."""
+    from defer_tpu.runtime.paged import _PPLocalStage
+
+    stage = _PPLocalStage(
+        dec, params, first, last,
+        num_blocks=num_blocks, block_size=block_size,
+        attention=attention,
+    )
+    recv = ArrayReceiver(
+        listen_port, host=listen_host, accept_timeout_s=accept_timeout_s
+    )
+    if announce is not None:
+        announce(recv.port)
+    it = iter(recv)
+    log.info(
+        "pp stage worker ready (layers [%d, %d), pool %d bytes); "
+        "results to %s:%d",
+        first, last, stage.pool_bytes, result_host, result_port,
+    )
+    sender = ArraySender(result_host, result_port)
+    count = 0
+    try:
+        while True:
+            bundle = _read_bundle(it, 6)
+            if bundle is None:
+                return count
+            tables, pos, xin, n_keep, keep_from, adapter = bundle
+            out = stage.pp_dispatch(
+                tables, pos, xin, n_keep, keep_from, adapter
+            )
+            # analysis: ignore[host-sync-in-hot-loop] the worker's job
+            # is to frame the result back onto the wire — this
+            # device->host copy IS the stage boundary here
+            sender.send(np.asarray(out))
+            count += 1
+    finally:
+        sender.close()
+        recv.close()
+        stage.close()
+
+
 def main(argv: list[str] | None = None) -> None:
     import argparse
 

@@ -33,6 +33,12 @@ the tensor-parallel serving sweep (model_axis in {1,2,4,8} on a
 {"model": m} mesh, runtime/paged.py `mesh=`) pricing tokens/sec,
 tokens-per-dispatch and per-shard KV rows read per axis size;
 bench.py runs it as the "tp_serving" extras section. And
+`run_pp_sweep(devices) -> dict` (`--pp-sweep`) — the
+pipeline-parallel serving sweep (pp_stages S in {1,2,4} crossed with
+in-flight microbatch counts M, runtime/paged.py `pp_stages=`) pricing
+tokens/sec, the MEASURED bubble fraction and per-stage occupancy of
+the dispatch-slot schedule, and per-stage KV-pool bytes (~1/S each);
+bench.py runs it as the "pp_serving" extras section. And
 `run_kv_quant_sweep(devices) -> dict` (`--kv-quant-sweep`) — the
 KV-quantization sweep (kv_dtype fp vs int8 over the same
 over-subscribed Zipf prefix mix with the host-RAM spill tier on)
@@ -625,6 +631,155 @@ def run_tp_sweep(
     return out
 
 
+def run_pp_sweep(
+    devices=None,
+    *,
+    grid: tuple = ((1, 1), (2, 2), (4, 2), (4, 4)),
+    decode_window: int = 8,
+    num_layers: int = 4,
+    dim: int = 128,
+    num_heads: int = 4,
+    num_kv_heads: int = 4,
+    vocab_size: int = 1024,
+    max_len: int = 256,
+    num_blocks: int = 33,
+    block_size: int = 8,
+    max_batch: int = 4,
+    num_requests: int = 8,
+) -> dict:
+    """Pipeline-parallel serving sweep: the same fixed request mix
+    served with the layer stack cut into S stages (one device and one
+    KV-pool slice per stage) at M in-flight microbatch groups, for
+    each (S, M) in `grid`. Returns {config, device_kind, num_devices,
+    skipped, grid: {"s{S}_m{M}": {tokens_per_sec, speedup_vs_s1,
+    bubble_fraction, stage_occupancy, stage_dispatches,
+    stage_pool_bytes, pool_bytes_vs_s1, cut_starts}}} — keys are
+    flat "s2_m2" strings so budgets.toml bench_metric paths can
+    navigate them.
+
+    The points being measured: bubble_fraction is the MEASURED idle
+    share of the dispatch-slot schedule (runtime/batching.py
+    `pp_schedule_occupancy` over what the tick actually dispatched,
+    last window) — (S-1)/(S-1 + chains) when every group stays live,
+    shrinking as M and decode_window amortize the fill/drain ramps;
+    per-stage pool bytes must sum to ~the S=1 pool (each stage holds
+    ONLY its layers' slice); and tokens/sec prices the overlap.
+    Wall-clock speedup needs real parallel hardware — stages on forced
+    host devices share the machine's cores, so on a small CPU rig the
+    schedule metrics, not tokens/sec, carry the claim (the ROADMAP's
+    standing caution about absolute CPU numbers applies doubly here).
+    (S, M) points needing more devices than visible are skipped and
+    reported; M never exceeds max_batch."""
+    import jax
+    import jax.numpy as jnp
+
+    from defer_tpu.models.gpt import GptDecoder
+    from defer_tpu.models.llama import llama_config
+    from defer_tpu.parallel.mesh import describe_topology
+    from defer_tpu.runtime.paged import serve_paged
+
+    devs = list(devices) if devices else jax.devices()
+    cfg = llama_config(
+        num_layers=num_layers,
+        dim=dim,
+        num_heads=num_heads,
+        num_kv_heads=num_kv_heads,
+        ffn_dim=dim * 2,
+        vocab_size=vocab_size,
+        max_len=max_len,
+    )
+    dec = GptDecoder(cfg, compute_dtype=jnp.bfloat16)
+    params = dec.cast_params(dec.init(jax.random.key(0)))
+    reqs = []
+    for i in range(num_requests):
+        t0 = 16 + (i * 23) % 112
+        steps = 16 + (i * 11) % 48
+        prompt = jax.random.randint(
+            jax.random.fold_in(jax.random.key(1), i),
+            (1, t0),
+            0,
+            cfg.vocab_size,
+        )
+        reqs.append((prompt, steps))
+    total_tokens = sum(s for _, s in reqs)
+    topo = describe_topology()
+    out: dict = {
+        "config": {
+            "num_layers": num_layers,
+            "dim": dim,
+            "heads": f"{num_heads}/{num_kv_heads}kv",
+            "max_len": max_len,
+            "num_blocks": num_blocks,
+            "block_size": block_size,
+            "max_batch": max_batch,
+            "requests": num_requests,
+            "total_tokens": total_tokens,
+            "decode_window": decode_window,
+        },
+        "device_kind": topo["device_kind"],
+        "num_devices": len(devs),
+        "skipped": [
+            f"s{s}_m{m}"
+            for s, m in grid
+            if s > len(devs) or m > max_batch or max_batch % m
+        ],
+        "grid": {},
+    }
+    base_tps = None
+    base_pool = None
+    for s, m in grid:
+        if s > len(devs) or m > max_batch or max_batch % m:
+            continue
+        pp = (
+            {}
+            if s == 1
+            else {
+                "pp_stages": s,
+                "pp_inflight": m,
+                "pp_devices": devs[:s],
+            }
+        )
+
+        def run():
+            t0 = time.perf_counter()
+            outs, stats = serve_paged(
+                dec,
+                params,
+                reqs,
+                num_blocks=num_blocks,
+                block_size=block_size,
+                max_batch=max_batch,
+                decode_window=decode_window,
+                **pp,
+            )
+            jax.block_until_ready(outs[-1])
+            return time.perf_counter() - t0, stats
+
+        run()  # compile pass
+        dt, stats = run()
+        tps = total_tokens / dt
+        if s == 1:
+            base_tps = tps
+            base_pool = stats["pool_bytes"]
+        out["grid"][f"s{s}_m{m}"] = {
+            "tokens_per_sec": round(tps, 1),
+            "speedup_vs_s1": round(
+                tps / base_tps if base_tps else 0.0, 3
+            ),
+            "bubble_fraction": round(stats["pp_bubble_fraction"], 4),
+            "stage_occupancy": [
+                round(o, 4) for o in stats["pp_stage_occupancy"]
+            ],
+            "stage_dispatches": stats["pp_stage_dispatches"],
+            "stage_pool_bytes": stats["pp_stage_pool_bytes"],
+            "pool_bytes_vs_s1": round(
+                stats["pool_bytes"] / base_pool if base_pool else 0.0, 4
+            ),
+            "cut_starts": stats["pp_cut_starts"],
+        }
+    return out
+
+
 def run_kv_quant_sweep(
     devices=None,
     *,
@@ -1088,6 +1243,26 @@ def main() -> None:
         "constrained fused-window path)",
     )
     ap.add_argument(
+        "--pp-sweep",
+        action="store_true",
+        help="run the pipeline-parallel serving sweep (pp_stages x "
+        "in-flight microbatches = --pp-grid; points needing more "
+        "devices than visible are skipped and reported) instead of "
+        "the attention microbench",
+    )
+    ap.add_argument(
+        "--pp-grid",
+        default="s1_m1,s2_m2,s4_m2,s4_m4",
+        help="comma-separated s{S}_m{M} points for --pp-sweep",
+    )
+    ap.add_argument(
+        "--pp-window",
+        type=int,
+        default=8,
+        help="decode_window for --pp-sweep (W rounds ride inside "
+        "each in-flight microbatch, amortizing the pipeline ramps)",
+    )
+    ap.add_argument(
         "--tp-sweep",
         action="store_true",
         help="run the tensor-parallel serving sweep (model_axis = "
@@ -1163,6 +1338,36 @@ def main() -> None:
             modes=modes,
             decode_window=args.constrain_window,
             **shared,
+        )
+    elif args.pp_sweep:
+        # Same default-dropping as --spec-sweep: run_pp_sweep's own
+        # (smaller) model defaults win unless a flag was explicitly
+        # overridden.
+        arg_of = {
+            "num_layers": "layers",
+            "dim": "dim",
+            "num_heads": "heads",
+            "num_kv_heads": "kv_heads",
+            "vocab_size": "vocab",
+            "max_len": "max_len",
+            "num_blocks": "blocks",
+            "block_size": "block_size",
+            "max_batch": "batch",
+            "num_requests": "requests",
+        }
+        shared = {
+            k: v
+            for k, v in shared.items()
+            if v != ap.get_default(arg_of[k])
+        }
+        grid = []
+        for pt in args.pp_grid.split(","):
+            if not pt:
+                continue
+            s_part, _, m_part = pt.strip().partition("_")
+            grid.append((int(s_part.lstrip("s")), int(m_part.lstrip("m"))))
+        rec = run_pp_sweep(
+            grid=tuple(grid), decode_window=args.pp_window, **shared
         )
     elif args.tp_sweep:
         # Same default-dropping as --spec-sweep: run_tp_sweep's own

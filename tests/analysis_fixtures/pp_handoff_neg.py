@@ -1,0 +1,54 @@
+"""NEGATIVE: the stage handoff the pipeline-parallel paged server
+ships (runtime/paged.py `_tick_pp`) — boundary activations hop stages
+as ASYNC `jax.device_put` futures, so dispatching round k for group g
+never waits on any stage's compute; the one device->host copy sits in
+the per-window drain behind its justified ignore, exactly like the
+monolithic tick. The transport-placed stage worker thread
+(runtime/remote_stage.py `serve_pp_stage`) owns its own domain: its
+wire framing is a host copy BY DESIGN and carries the justification
+inline."""
+
+import threading
+
+import jax
+import numpy as np
+
+
+class PipelinedServer:
+    def _tick(self):
+        return self._tick_pp()
+
+    def _tick_pp(self):
+        for k in range(self.decode_window):
+            for group in self.groups:
+                act = group.feed
+                for stage in self.stages:
+                    # async handoff: device_put of a device-resident
+                    # future enqueues a copy, never blocks the host
+                    act = stage.pp_dispatch(jax.device_put(act, stage.dev))
+                group.feed = act
+        # analysis: ignore[host-sync-in-hot-loop] ONE batched drain per
+        # window, same cadence the monolithic _tick pays
+        toks = np.asarray(self._window_tokens())
+        return toks
+
+    def _window_tokens(self):
+        return self.groups[0].feed
+
+
+class StageWorker:
+    def __init__(self, stage, wire):
+        self.stage = stage
+        self.wire = wire
+        self._thread = threading.Thread(
+            target=self._serve, name="pp-stage-worker", daemon=True
+        )
+
+    # analysis: domain(pp-stage-worker) the worker thread owns the
+    # stage session; the controller only reaches it over the wire
+    def _serve(self):
+        for bundle in self.wire:
+            out = self.stage.pp_dispatch(bundle)
+            # analysis: ignore[host-sync-in-hot-loop] framing the
+            # result onto the wire IS the stage boundary here
+            self.wire.send(np.asarray(out))

@@ -1,0 +1,24 @@
+"""POSITIVE: host-sync-in-hot-loop over the pipeline-parallel stage
+handoff — the boundary activation is pulled to the HOST between every
+stage dispatch, so each decode round pays S device->host round trips
+and no two stages can ever overlap (the handoff blocks on the producer
+before the consumer is even enqueued)."""
+
+import numpy as np
+
+
+class PipelinedServer:
+    def _tick(self):
+        return self._tick_pp()
+
+    def _tick_pp(self):
+        for k in range(self.decode_window):
+            for group in self.groups:
+                act = group.feed
+                for stage in self.stages:
+                    out = stage.pp_dispatch(act)
+                    # host round trip per stage per round: kills the
+                    # async-dispatch overlap the pipeline exists for
+                    act = np.asarray(out)
+                group.feed = act
+        return self.groups

@@ -41,6 +41,7 @@ CASES = [
     ("lock-discipline", "lock_helper", 1),
     ("obs-name-drift", "obs_drift", 3),
     ("cross-domain-write", "domain_race", 2),
+    ("host-sync-in-hot-loop", "pp_handoff", 1),
     ("shard-spec", "shard_spec", 3),
     ("shard-spec", "psum_mirror", 1),
 ]
@@ -270,6 +271,7 @@ def test_repo_budget_gate_and_suppression_ledger(capsys):
     assert set(verdicts) == {
         "dispatches_per_token_w8",
         "kv_rows_per_shard_tp2",
+        "pp",
         "window_drain_b_k",
     }
     assert all(s in ("pass", "no-data") for s in verdicts.values())
