@@ -564,6 +564,28 @@ def bench_decode_window(devices) -> dict:
     return rec
 
 
+def bench_mixed_serving(devices) -> dict:
+    """Mixed-mode continuous batching (scripts/bench_paged.py): the
+    same request mix offered open-loop, served with stall-mode
+    admission vs prefill_budget in {64,128,256,inf}, pricing the live
+    slots' ITL p99 (where admission-prefill stalls land) against TTFT
+    and the decode-stall fraction per budget."""
+    import importlib.util
+    import os
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "scripts",
+        "bench_paged.py",
+    )
+    spec = importlib.util.spec_from_file_location("bench_paged", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rec = mod.run_mixed_sweep(devices)
+    log(f"mixed serving sweep: {rec}")
+    return rec
+
+
 def bench_speculative(devices) -> dict:
     """Paged speculative decoding (scripts/bench_paged.py): the same
     request mix served at spec_k in {0,2,4} crossed with the draft
@@ -978,6 +1000,7 @@ def run_bench() -> dict:
         "paged_server": None,
         "paged_attention": None,
         "decode_window": None,
+        "mixed_serving": None,
         "speculative": None,
         "tp_serving": None,
         "pp_serving": None,
@@ -1134,6 +1157,7 @@ def run_bench() -> dict:
             ("paged_server", bench_paged_server),
             ("paged_attention", bench_paged_attention),
             ("decode_window", bench_decode_window),
+            ("mixed_serving", bench_mixed_serving),
             ("speculative", bench_speculative),
             ("tp_serving", bench_tp_serving),
             ("pp_serving", bench_pp_serving),

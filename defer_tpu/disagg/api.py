@@ -317,6 +317,11 @@ def serve_disagg(
             srv.radix.cached_blocks if srv.radix is not None else 0
         ),
         prefill_tokens_saved=srv.prefill_tokens_saved,
+        prefill_budget=srv.prefill_budget,
+        prefill_stall_ticks=srv.prefill_stall_ticks_n,
+        mixed_ticks=srv.mixed_ticks_n,
+        mixed_prefill_tokens=srv.mixed_prefill_tokens_n,
+        decode_stall_fraction=srv.decode_stall_fraction_last,
         kv_dtype=srv.kv_dtype,
         pool_bytes=srv.pool_bytes,
         spec_k=srv.spec_k,

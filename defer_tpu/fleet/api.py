@@ -340,6 +340,13 @@ class FleetFrontend:
                         else 0
                     ),
                     prefill_tokens_saved=srv.prefill_tokens_saved,
+                    prefill_budget=srv.prefill_budget,
+                    prefill_stall_ticks=srv.prefill_stall_ticks_n,
+                    mixed_ticks=srv.mixed_ticks_n,
+                    mixed_prefill_tokens=srv.mixed_prefill_tokens_n,
+                    decode_stall_fraction=(
+                        srv.decode_stall_fraction_last
+                    ),
                     mesh_shape=srv.mesh_label,
                     kv_dtype=srv.kv_dtype,
                     pool_bytes=srv.pool_bytes,

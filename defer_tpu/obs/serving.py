@@ -193,6 +193,31 @@ class ServingMetrics:
             "on-device, or a stop sequence discarded the tail on "
             "drain)", labels,
         )
+        # Continuous-batching interference (runtime/schedule.py +
+        # runtime/paged.py `prefill_budget=`): how much decode time
+        # admission prefill steals. In the serialized stall path every
+        # prefill dispatch issued while a decode slot is live is a
+        # stall tick; mixed-mode ticks carry prompt chunks inside the
+        # decode dispatch instead, so stall ticks stay 0 and the
+        # fraction gauge reads ~0.
+        self.prefill_stall_ticks = reg.counter(
+            "defer_prefill_stall_ticks_total",
+            "Admission-prefill dispatches issued while at least one "
+            "decode slot sat stalled waiting for the tick loop "
+            "(serialized-prefill interference; 0 under "
+            "prefill_budget=)", labels,
+        )
+        self.mixed_prefill_tokens = reg.counter(
+            "defer_mixed_prefill_tokens_total",
+            "Prompt tokens carried by fused mixed decode+prefill "
+            "dispatches (prefill_budget= ticks)", labels,
+        )
+        self.decode_stall_fraction = reg.gauge(
+            "defer_decode_stall_fraction",
+            "Fraction of decode-capable dispatch slots spent stalled "
+            "behind admission prefill: stall_ticks / (decode_ticks + "
+            "stall_ticks)", labels,
+        )
         # Speculative decoding (models/speculative.py solo loop and
         # runtime/paged.py paged serving both report through these).
         # acceptance = accepted/proposed is the one-number health

@@ -277,6 +277,25 @@ def accept_lengths(props, preds):
     return np.where(mismatch.any(axis=1), first_bad, props.shape[1])
 
 
+def poisson_arrivals(n: int, rate: float, seed: int = 0) -> np.ndarray:
+    """Deterministic open-loop arrival schedule: `n` absolute arrival
+    offsets (seconds, float64, non-decreasing, starting at 0.0) drawn
+    from a Poisson process of `rate` requests/second. Open-loop means
+    arrivals do NOT wait for service — the schedule is fixed up front,
+    so a slow server accumulates backlog instead of throttling its
+    own offered load (the closed-loop artifact that hides stalls).
+    Seeded numpy, no wall clock: the same (n, rate, seed) is the same
+    trace everywhere it's replayed (scripts/bench_paged.py
+    --mixed-sweep prices prefill/decode interference against it)."""
+    if n < 1:
+        raise ValueError(f"need n >= 1 arrivals, got {n}")
+    if rate <= 0:
+        raise ValueError(f"arrival rate must be > 0, got {rate}")
+    gaps = np.random.default_rng(seed).exponential(1.0 / rate, size=n)
+    gaps[0] = 0.0  # first request arrives at t=0
+    return np.cumsum(gaps)
+
+
 def microbatch_groups(max_batch: int, num_groups: int) -> list[list[int]]:
     """Partition the slot indices [0, max_batch) into `num_groups`
     contiguous microbatch groups for pipelined decode

@@ -46,6 +46,7 @@ bit-identical to solo generation over the concatenated ids.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import time
 from typing import Any
@@ -662,7 +663,10 @@ class DecodeServer:
         # rows are dummies.
         self._feed = jnp.zeros((max_batch, 1), jnp.int32)
         self._sampler = SlotSampler(max_batch)
-        self.pending: list[tuple] = []
+        # Deque, not list: admission pops from the head every time a
+        # seat frees, and a list's pop(0) is O(queue depth) — a deep
+        # backlog would make each admission scan the whole tail.
+        self.pending: collections.deque[tuple] = collections.deque()
         self.done: dict[int, jax.Array] = {}
         self._next_id = 0
         self.ticks = 0
@@ -772,13 +776,14 @@ class DecodeServer:
             if slot.req is not None or not self.pending:
                 continue
             (rid, prompt, steps, adapter_id, samp,
-             stop_seqs, cid) = self.pending.pop(0)
+             stop_seqs, cid) = self.pending.popleft()
             t0 = prompt.shape[1]
             self.obs.requests_admitted.inc()
             self.obs.prefill_tokens.inc(t0)
+            # Strict lookup: an unknown rid would silently observe a
+            # zero queue wait — a missing submit timestamp is a bug.
             self.obs.queue_wait.observe(
-                time.perf_counter()
-                - self._submit_t.get(rid, time.perf_counter())
+                time.perf_counter() - self._submit_t[rid]
             )
             P = self.prefix_len
             rolling = getattr(self.dec, "rolling_cache", False)
@@ -854,6 +859,11 @@ class DecodeServer:
             state = jnp.maximum(row[first[0, 0].astype(jnp.int32)], 0)
             self._sampler.admit_constraint(i, cid, state)
             frac = crt.masked_frac(mask[None, :], jnp.asarray([True]))
+            # analysis: ignore[host-sync-in-hot-loop] once per
+            # CONSTRAINED admission (first token only), not per tick —
+            # the paged server's mixed-mode flips made _first_token
+            # tick-reachable by name; the steady-state tick never
+            # reaches this branch in either server
             self.obs.constrain_masked_frac.observe(float(frac[0]))
             self.obs.constrained_tokens.inc()
             self.constrained_tokens_n += 1
@@ -884,9 +894,10 @@ class DecodeServer:
         # TTFT is host-side: submit() to first-token DISPATCH (the
         # token array may still be in flight on device — honesty note
         # in ARCHITECTURE.md "Observability").
+        # ttft spans queue + prefill (popped here, the drain point —
+        # strict: a missing rid means the timestamp was never pinned).
         self.obs.ttft.observe(
-            time.perf_counter()
-            - self._submit_t.pop(rid, time.perf_counter())
+            time.perf_counter() - self._submit_t.pop(rid)
         )
         self.obs.tokens_generated.inc()
         slot.req = rid

@@ -70,6 +70,7 @@ allocated blocks in one jitted op.
 
 from __future__ import annotations
 
+import collections
 import hashlib
 import queue
 import threading
@@ -99,6 +100,7 @@ from defer_tpu.runtime.batching import (
     window_drain_order,
 )
 from defer_tpu.runtime.decode_server import DraftLanes, SlotSampler
+from defer_tpu.runtime.schedule import PrefillSeat, plan_mixed_tick
 from defer_tpu.runtime.stopping import matcher_or_none, normalize_stops
 
 
@@ -971,6 +973,8 @@ class PagedDecodeServer:
         spec_params: dict | None = None,
         spec_k: int = 0,
         prefill_chunk: int | None = None,
+        prefill_budget: int | None = None,
+        prefill_lookahead: int = 2,
         mesh: Any = None,
         model_axis: str = "model",
         device: Any = None,
@@ -1060,6 +1064,30 @@ class PagedDecodeServer:
         the prompt's LIVE blocks, never with pool size (the
         `defer_kv_rows_*` counters price it). None (default) keeps
         the contiguous prefill + insert path.
+
+        `prefill_budget` — STALL-FREE continuous batching
+        (ARCHITECTURE.md "Continuous batching & prefill scheduling"):
+        instead of running each admitted prompt's prefill to
+        completion while every live slot stalls, a new request takes
+        a SEAT whose `pos` advances chunk by chunk, and each decode
+        dispatch carries the live decode rows PLUS up to this many
+        prompt tokens from the seated prefills, fused into one
+        multi-token forward (runtime/schedule.py plans the tick;
+        _tick_mixed dispatches it). Decode rows always advance
+        exactly one token per mixed tick — sampling/eos/stop apply
+        only to them — and a seat flips to decoding the tick its
+        last chunk lands (that chunk's final logits row seeds the
+        slot's first token, exactly the stall path's admission draw).
+        Greedy output is token-identical to `prefill_budget=None`
+        across attention modes x prefix_cache x decode_window x tp;
+        radix admits schedule only the non-shared suffix and publish
+        their fresh blocks at flip time; `submit_prefilled` seats
+        bypass the budget (their compute is already spent). At most
+        `prefill_lookahead` seats prefill concurrently (bounded
+        lookahead keeps admission near-FIFO). None (default) keeps
+        the serialized stall-prefill admission path bit-identically.
+        Deferred compositions raise with the fix spelled out:
+        spec_k > 0 and pp_stages > 1.
 
         `decode_window` — decode sub-steps fused into ONE jitted host
         dispatch (K), the paged twin of DecodeServer's parameter (its
@@ -1199,6 +1227,36 @@ class PagedDecodeServer:
             raise ValueError(
                 f"prefill_chunk must be >= 1, got {prefill_chunk}"
             )
+        if prefill_lookahead < 1:
+            raise ValueError(
+                f"prefill_lookahead must be >= 1, got {prefill_lookahead}"
+            )
+        if prefill_budget is not None:
+            if prefill_budget < 1:
+                raise ValueError(
+                    f"prefill_budget must be >= 1 prompt tokens per "
+                    f"tick, got {prefill_budget}"
+                )
+            if spec_k:
+                raise ValueError(
+                    "prefill_budget does not compose with spec_k > 0 "
+                    "yet: the verify forward already owns the "
+                    "multi-token rows a mixed tick would budget, and "
+                    "fusing draft catch-up with mid-prefill seats "
+                    "needs a draft-side seat lifecycle. Fix: serve "
+                    "speculation on a prefill_budget=None server, or "
+                    "set spec_k=0 here."
+                )
+            if pp_stages > 1:
+                raise ValueError(
+                    "prefill_budget does not compose with pp_stages "
+                    "> 1 yet: the pipelined window schedules whole "
+                    "microbatch groups and a mixed tick would need "
+                    "per-stage budget accounting across the in-flight "
+                    "groups. Fix: run mixed-mode admission on a "
+                    "pp_stages=1 server (tensor-parallel via mesh= "
+                    "composes), or set prefill_budget=None here."
+                )
         if mesh is not None and device is not None:
             raise ValueError(
                 "mesh= and device= are mutually exclusive: a mesh "
@@ -1614,7 +1672,10 @@ class PagedDecodeServer:
         # that dominates at small models). Idle rows are dummies.
         self._feed = jnp.zeros((max_batch, 1), jnp.int32)
         self._sampler = SlotSampler(max_batch)
-        self.pending: list[tuple] = []
+        # deque, not list: admission consumes from the head every
+        # _admit pass, and a deep open-loop backlog would turn
+        # list.pop(0) into O(queue) per admission.
+        self.pending: collections.deque[tuple] = collections.deque()
         # Externally prefilled admissions (disagg/): rid -> request
         # entry whose "kv" field a transport ingest fills in from
         # another thread (deliver_kv). Admission order follows
@@ -1683,6 +1744,17 @@ class PagedDecodeServer:
         self._spill_up = None
         self.spec_k = spec_k
         self.prefill_chunk = prefill_chunk
+        self.prefill_budget = prefill_budget
+        self.prefill_lookahead = prefill_lookahead
+        # Stall/mixed accounting (host mirrors of the obs instruments,
+        # for ServerStats snapshots without a registry read):
+        # stall ticks = admission-prefill dispatches issued while at
+        # least one decode slot sat waiting (always 0 in mixed mode);
+        # mixed tokens = prompt tokens carried by fused mixed ticks.
+        self.prefill_stall_ticks_n = 0
+        self.mixed_prefill_tokens_n = 0
+        self.mixed_ticks_n = 0
+        self.decode_stall_fraction_last = 0.0
         # Draft lanes (runtime/decode_server.py::DraftLanes): the
         # draft model's flat per-slot K/V plus host position truth.
         self._draft = (
@@ -3631,6 +3703,11 @@ class PagedDecodeServer:
                 )
             self._account_kv_rows_prefill(pos0, pad_t)
             self._account_psums(1)
+            # Serialized-prefill interference: this chunk dispatch ran
+            # INSTEAD of a decode tick for every live slot
+            # (prefill_budget= admits through _tick_mixed and never
+            # reaches here with decode slots live).
+            self._note_prefill_stall(1)
             logits_row = logits[:, real - 1, :]
             start += real
         if self.pp > 1:
@@ -3810,9 +3887,10 @@ class PagedDecodeServer:
         self.obs.requests_admitted.inc()
         self.obs.prefix_hits.inc(len(hits))
         self.obs.prefix_misses.inc(n_full - len(hits))
+        # Strict lookup: an unknown rid would silently observe a zero
+        # queue wait — admission without a submit timestamp is a bug.
         self.obs.queue_wait.observe(
-            time.perf_counter()
-            - self._submit_t.get(rid, time.perf_counter())
+            time.perf_counter() - self._submit_t[rid]
         )
         self._build()
         table_row = np.zeros((self.MB,), np.int32)
@@ -3863,6 +3941,7 @@ class PagedDecodeServer:
                 self.params, small, padded
             )
             self._account_psums(1)
+            self._note_prefill_stall(1)
             # Dynamic-skip insert: hit blocks are never rewritten
             # (their recomputed rows are equivalent but not guaranteed
             # bit-identical, and they belong to every other holder of
@@ -3915,9 +3994,10 @@ class PagedDecodeServer:
             slot["pend"] = [int(first[0, 0])]
             self._draft.admit(i, prompt)
         self._feed = self._feed.at[i].set(first[0].astype(jnp.int32))
+        # ttft spans queue + prefill (popped here, the drain point —
+        # entries must not outlive their request).
         self.obs.ttft.observe(
-            time.perf_counter()
-            - self._submit_t.pop(rid, time.perf_counter())
+            time.perf_counter() - self._submit_t.pop(rid)
         )
         self._update_pool_gauges()
         need_host = (
@@ -4007,9 +4087,10 @@ class PagedDecodeServer:
         if self.radix is not None:
             self.obs.prefix_hits.inc(len(hits))
             self.obs.prefix_misses.inc(n_full - len(hits))
+        # Strict lookup: an unknown rid would silently observe a zero
+        # queue wait — admission without a submit timestamp is a bug.
         self.obs.queue_wait.observe(
-            time.perf_counter()
-            - self._submit_t.get(rid, time.perf_counter())
+            time.perf_counter() - self._submit_t[rid]
         )
         self._build()
         insert_dyn = self._ensure_insert_dyn()
@@ -4071,9 +4152,10 @@ class PagedDecodeServer:
             slot["pend"] = [int(first[0, 0])]
             self._draft.admit(i, jnp.asarray(prompt))
         self._feed = self._feed.at[i].set(first[0].astype(jnp.int32))
+        # ttft spans queue + prefill (popped here, the drain point —
+        # entries must not outlive their request).
         self.obs.ttft.observe(
-            time.perf_counter()
-            - self._submit_t.pop(rid, time.perf_counter())
+            time.perf_counter() - self._submit_t.pop(rid)
         )
         self._update_pool_gauges()
         need_host = (
@@ -4103,6 +4185,10 @@ class PagedDecodeServer:
             state = jnp.maximum(row[first[0, 0].astype(jnp.int32)], 0)
             self._sampler.admit_constraint(i, cid, state)
             frac = crt.masked_frac(mask[None, :], jnp.asarray([True]))
+            # analysis: ignore[host-sync-in-hot-loop] once per
+            # CONSTRAINED admission (first token only), not per tick —
+            # mixed-mode flips route here but a flip happens once per
+            # request; the steady-state tick never reaches this branch
             self.obs.constrain_masked_frac.observe(float(frac[0]))
             self.obs.constrained_tokens.inc()
             self.constrained_tokens_n += 1
@@ -4173,6 +4259,11 @@ class PagedDecodeServer:
         return None
 
     def _admit(self) -> None:
+        if self.prefill_budget is not None:
+            # Mixed-mode admission: new prompts take SEATS and prefill
+            # inside the decode dispatches (runtime/schedule.py) — the
+            # serialized stall-prefill path below never runs.
+            return self._admit_mixed()
         for i in range(self.B):
             if self.slots[i] is not None:
                 continue
@@ -4194,7 +4285,7 @@ class PagedDecodeServer:
                     cid,
                 ):
                     return  # pool exhausted even after eviction
-                self.pending.pop(0)
+                self.pending.popleft()
                 continue
             t0 = prompt.shape[1]
             P = self.prefix_len
@@ -4202,13 +4293,14 @@ class PagedDecodeServer:
             need = self._own_need(t0, steps)
             if need > len(self.free):
                 return  # pool exhausted: wait for a finisher
-            self.pending.pop(0)
+            self.pending.popleft()
             blocks = [self.free.pop() for _ in range(need)]
             self.obs.requests_admitted.inc()
             self.obs.prefill_tokens.inc(t0)
+            # Strict lookup (same rule as the radix/prefilled paths):
+            # a missing rid is a bug, not a zero wait.
             self.obs.queue_wait.observe(
-                time.perf_counter()
-                - self._submit_t.get(rid, time.perf_counter())
+                time.perf_counter() - self._submit_t[rid]
             )
             self._build()
             self.blocks_peak = max(
@@ -4263,6 +4355,7 @@ class PagedDecodeServer:
                     self.params, small, padded
                 )
                 self._account_psums(1)
+                self._note_prefill_stall(1)
                 self.pool_k, self.pool_v = self._insert(
                     self.pool_k,
                     self.pool_v,
@@ -4298,9 +4391,10 @@ class PagedDecodeServer:
             self._feed = self._feed.at[i].set(
                 first[0].astype(jnp.int32)
             )
+            # ttft spans queue + prefill; popped here (the drain
+            # point) so entries never outlive their request.
             self.obs.ttft.observe(
-                time.perf_counter()
-                - self._submit_t.pop(rid, time.perf_counter())
+                time.perf_counter() - self._submit_t.pop(rid)
             )
             self._update_pool_gauges()
             # Host transfer only when eos/streaming/stop matching
@@ -4315,6 +4409,490 @@ class PagedDecodeServer:
                 i, slot, int(first[0, 0]) if need_host else None
             )
 
+    # -- mixed-mode admission + tick (prefill_budget=) ----------------
+
+    def _seat_slots(self) -> list[int]:
+        """Slot indices currently holding a PREFILL SEAT (admitted,
+        mid-prefill, not yet decoding), admission order == slot-scan
+        order because _admit_mixed seats the queue head first."""
+        return [
+            i
+            for i, s in enumerate(self.slots)
+            if s is not None and "prefill" in s
+        ]
+
+    def _note_prefill_stall(self, n_dispatches: int) -> None:
+        """Account `n_dispatches` admission-prefill dispatches issued
+        by the SERIALIZED path: each one issued while a decode slot is
+        live is a stall tick (that slot's tick loop sat waiting).
+        Mixed-mode ticks never call this — their prefill rides inside
+        the decode dispatch."""
+        if any(
+            s is not None and "prefill" not in s for s in self.slots
+        ):
+            self.prefill_stall_ticks_n += n_dispatches
+            self.obs.prefill_stall_ticks.inc(n_dispatches)
+        self._update_stall_fraction()
+
+    def _update_stall_fraction(self) -> None:
+        """Publish decode_stall_fraction = stall_ticks / (decode ticks
+        + stall_ticks): of all the dispatch slots that could have
+        advanced decode, the fraction admission prefill stole."""
+        denom = self.ticks + self.prefill_stall_ticks_n
+        frac = self.prefill_stall_ticks_n / denom if denom else 0.0
+        self.decode_stall_fraction_last = frac
+        self.obs.decode_stall_fraction.set(frac)
+
+    def _admit_mixed(self) -> None:
+        """Seat-only admission for `prefill_budget=` servers: a new
+        request claims a free slot and its blocks immediately, but NO
+        prefill runs here — its prompt tokens ride inside subsequent
+        mixed decode dispatches (_tick_mixed) until the last chunk
+        lands and the seat flips to decoding (_flip_seat). Externally
+        prefilled requests (submit_prefilled) bypass the budget: their
+        compute is already spent, so they seat exactly as before."""
+        for i in range(self.B):
+            if self.slots[i] is not None:
+                continue
+            seated = self._admit_prefilled_ready(i)
+            if seated:
+                continue
+            if seated is False:
+                return  # pool exhausted even after eviction
+            if not self.pending:
+                continue
+            if len(self._seat_slots()) >= self.prefill_lookahead:
+                # Bounded lookahead: enough prompts are already
+                # sharing the budget — admission stays near-FIFO.
+                return
+            (rid, prompt, steps, adapter_id, samp,
+             stop_seqs, cid) = self.pending[0]
+            if self.radix is not None:
+                ok = self._seat_radix(
+                    i, rid, prompt, steps, adapter_id, samp,
+                    stop_seqs, cid,
+                )
+            else:
+                ok = self._seat_plain(
+                    i, rid, prompt, steps, adapter_id, samp,
+                    stop_seqs, cid,
+                )
+            if not ok:
+                return  # pool exhausted: wait for a finisher
+            self.pending.popleft()
+
+    def _seat_common(
+        self, i, rid, prompt, steps, adapter_id, samp, stop_seqs,
+        cid, seat, blocks, shared,
+    ) -> None:
+        """Shared tail of both seat paths: install the mid-prefill
+        slot dict + host rows. `pos[i]` starts at the seat's base and
+        advances per chunk; sampling/stop/constraint state installs
+        at FLIP time (admit_first reseeds the sampler row then, so
+        sampled streams match the stall path token for token)."""
+        self._build()
+        self.blocks_peak = max(self.blocks_peak, self.blocks_in_use)
+        self.adapter[i] = adapter_id
+        self.pos[i] = seat.base
+        self.slots[i] = {
+            "rid": rid,
+            "prefill": seat,
+            "meta": {
+                "prompt": prompt,
+                "steps": steps,
+                "samp": samp,
+                "stop": stop_seqs,
+                "cid": cid,
+            },
+            "blocks": blocks,
+            "shared": shared,
+            "sampling": samp is not None,
+            "stop": None,
+            "cid": 0,
+        }
+        self._update_pool_gauges()
+
+    def _seat_plain(
+        self, i, rid, prompt, steps, adapter_id, samp, stop_seqs, cid
+    ) -> bool:
+        """Seat a request on a non-radix server: allocate its blocks
+        (plus pointers at the global shared prefix), schedule the
+        whole prompt at base=prefix_len. False = pool can't cover it
+        yet."""
+        t0 = prompt.shape[1]
+        need = self._own_need(t0, steps)
+        if need > len(self.free):
+            return False
+        blocks = [self.free.pop() for _ in range(need)]
+        self.obs.requests_admitted.inc()
+        self.obs.prefill_tokens.inc(t0)
+        # Strict lookup (satellite of the mixed-mode PR): a missing
+        # rid is a bug, not a zero wait.
+        self.obs.queue_wait.observe(
+            time.perf_counter() - self._submit_t[rid]
+        )
+        n_shared = len(self.shared_blocks)
+        table_row = np.zeros((self.MB,), np.int32)
+        for j, blk in enumerate(self.shared_blocks):
+            table_row[j] = blk
+        for j, blk in enumerate(blocks):
+            table_row[n_shared + j] = blk
+        self.tables[i] = table_row
+        seat = PrefillSeat(
+            rid=rid,
+            tokens=np.asarray(prompt)[0],
+            base=self.prefix_len,
+            keep_from=0,
+        )
+        self._seat_common(
+            i, rid, prompt, steps, adapter_id, samp, stop_seqs, cid,
+            seat, blocks, [],
+        )
+        return True
+
+    def _seat_radix(
+        self, i, rid, prompt, steps, adapter_id, samp, stop_seqs, cid
+    ) -> bool:
+        """Seat a request through the PrefixBlockCache: walk leading
+        full prompt blocks for hits (refcount++ now — they must stay
+        pinned while the seat prefills), allocate the rest, and
+        schedule ONLY the non-shared suffix. The request's own fresh
+        full-prompt blocks are NOT registered here: mid-prefill they
+        hold unwritten rows, so publication waits for _flip_seat."""
+        bs = self.bs
+        t0 = prompt.shape[1]
+        tokens = np.asarray(prompt)[0]
+        n_full = t0 // bs
+        total = -(-(t0 + steps) // bs)
+        hits, keys, toks = self.radix.walk(tokens, n_full, bs)
+        if self._spill is not None and len(hits) < n_full:
+            hits = self._revive_spilled(hits, keys, toks, n_full)
+        need = total - len(hits)
+        if need > len(self.free):
+            self.free.extend(self.radix.evict(need - len(self.free)))
+        if need > len(self.free):
+            for blk in hits:
+                self.radix.release(blk)
+            return False
+        own = [self.free.pop() for _ in range(need)]
+        self.obs.requests_admitted.inc()
+        self.obs.prefix_hits.inc(len(hits))
+        self.obs.prefix_misses.inc(n_full - len(hits))
+        self.obs.queue_wait.observe(
+            time.perf_counter() - self._submit_t[rid]
+        )
+        table_row = np.zeros((self.MB,), np.int32)
+        for j, blk in enumerate(hits + own):
+            table_row[j] = blk
+        self.tables[i] = table_row
+        # Reuse at most t0-1 cached positions: the LAST prompt token
+        # must run so its logits exist to seed the first generated
+        # token (same rule as the stall path).
+        suffix_pos = min(len(hits) * bs, t0 - 1)
+        self.obs.prefill_tokens.inc(t0 - suffix_pos)
+        self.prefill_tokens_saved += suffix_pos
+        seat = PrefillSeat(
+            rid=rid,
+            tokens=tokens[suffix_pos:],
+            base=suffix_pos,
+            keep_from=len(hits) * bs,
+        )
+        meta_extra = {
+            "keys": keys,
+            "toks": toks,
+            "n_full": n_full,
+            "n_hits": len(hits),
+        }
+        self._seat_common(
+            i, rid, prompt, steps, adapter_id, samp, stop_seqs, cid,
+            seat, own, list(hits),
+        )
+        self.slots[i]["meta"].update(meta_extra)
+        return True
+
+    def _flip_seat(self, i: int, slot: dict, lrow) -> None:
+        """The seat's last chunk just landed: seed the first generated
+        token from that chunk's final logits row (`lrow`, [1, V] —
+        exactly the row the stall path samples at admission) and turn
+        the seat into a decoding slot. Radix servers publish the
+        request's fresh full-prompt blocks NOW — every row is finally
+        written, so other requests may attend to them."""
+        meta = slot.pop("meta")
+        del slot["prefill"]
+        rid = slot["rid"]
+        prompt, steps = meta["prompt"], meta["steps"]
+        samp, cid = meta["samp"], meta["cid"]
+        if self.radix is not None:
+            n_hits, n_full = meta["n_hits"], meta["n_full"]
+            fresh = []
+            for j in range(n_hits, n_full):
+                blk = int(self.tables[i, j])
+                if meta["keys"][j] in self.radix.by_key:
+                    # A concurrently-prefilling seat with the same
+                    # prefix flipped first and published this key
+                    # (the stall path can't race here — its admits
+                    # serialize, so the second one WALKS into a hit).
+                    # Our duplicate block stays privately owned and
+                    # frees at finish; future walks hit theirs.
+                    continue
+                displaced = self.radix.register(
+                    meta["keys"][j], meta["toks"][j], blk
+                )
+                if displaced is not None:
+                    self.free.append(displaced)
+                fresh.append(blk)
+            # Registered blocks are shared (released through the
+            # radix at finish), no longer privately owned.
+            slot["shared"] = slot["shared"] + fresh
+            slot["blocks"] = [
+                b for b in slot["blocks"] if b not in fresh
+            ]
+        first = self._first_token(i, samp, lrow, prompt.dtype, cid)
+        slot["remaining"] = steps - 1
+        slot["last"] = first
+        slot["toks"] = [prompt, first]
+        slot["stop"] = matcher_or_none(meta["stop"])
+        slot["cid"] = cid
+        self._feed = self._feed.at[i].set(first[0].astype(jnp.int32))
+        # ttft = queue wait + (shared) prefill ticks, observed at the
+        # first token like every other admit path; strict pop drains
+        # the submit timestamp with the request.
+        self.obs.ttft.observe(
+            time.perf_counter() - self._submit_t.pop(rid)
+        )
+        self._update_pool_gauges()
+        need_host = (
+            self.eos_id is not None
+            or self.on_token is not None
+            or slot["stop"] is not None
+        )
+        # analysis: ignore[host-sync-in-hot-loop] one scalar transfer
+        # per REQUEST (its first token), and only when an
+        # eos/stop/stream consumer needs the value — the admission
+        # sync every admit path already performs
+        tok = int(first[0, 0]) if need_host else None
+        self._emit_token(i, slot, tok)
+
+    def _account_kv_rows_mixed(self, posm, t: int) -> None:
+        """Pool rows one mixed dispatch's attention read (decode-tick
+        units): a [B, T] multi-token step whose row b attends through
+        position posm[b] + t - 1. Derived from max_len (MB) and live
+        spans, never pool size."""
+        bs = self.bs
+        baseline = self.B * self.MB * bs
+        if self.attention == "gathered":
+            rows_read = baseline
+        elif self.attention == "blockwise":
+            rows_read = (
+                self.B
+                * ((int(posm.max()) + t - 1) // bs + 1)
+                * bs
+            )
+        else:  # pallas
+            win = self.dec.cfg.window
+            hi = (posm + t - 1) // bs
+            lo = (
+                np.maximum(posm + t - win, 0) // bs
+                if win is not None
+                else np.zeros_like(posm)
+            )
+            rows_read = int(np.sum(hi - lo + 1)) * bs
+        self._account_kv_rows(rows_read, baseline)
+
+    def _tick_mixed(self) -> None:
+        """One MIXED dispatch: every live decode row advances exactly
+        one token AND up to `prefill_budget` prompt tokens from the
+        prefill seats ride along, all in one jitted multi-token
+        forward (_mt_body — the spec-verify/chunked-prefill program).
+        Per-row mode: decode rows feed their last token at pos with
+        n_keep=1; seat rows feed their next chunk at base+done with
+        n_keep=len(chunk); idle rows keep nothing and write trash.
+        Sampling/eos/stop apply ONLY to decode rows; seat rows'
+        logits are discarded except the final chunk's last position,
+        which seeds the flip (_flip_seat)."""
+        seats = self._seat_slots()
+        decode_live = [
+            s is not None and "prefill" not in s for s in self.slots
+        ]
+        self._build()
+        mt = self._ensure_mt()
+        limit = self.MB * self.bs
+        # The fused program writes T contiguous-lane rows at EVERY
+        # row's position (gathered path), so T is bounded by the
+        # deepest live row — the same never-clamp invariant as
+        # submit()'s spec_k headroom and _prefill_paged's tail cap.
+        max_pos = max(
+            int(self.pos[i])
+            for i, s in enumerate(self.slots)
+            if s is not None
+        )
+        t_limit = limit - max_pos
+        chunk_cap = (
+            self.prefill_chunk
+            if self.prefill_chunk is not None
+            else limit
+        )
+        T, ns = plan_mixed_tick(
+            [self.slots[i]["prefill"].remaining for i in seats],
+            self.prefill_budget,
+            chunk_cap,
+            t_limit,
+        )
+        ids_np = np.zeros((self.B, T), np.int32)
+        n_keep = np.zeros((self.B,), np.int32)
+        keep_from = np.zeros((self.B,), np.int32)
+        posm = np.zeros((self.B,), np.int32)
+        emit_idx = np.zeros((self.B,), np.int32)
+        for i, s in enumerate(self.slots):
+            if decode_live[i]:
+                n_keep[i] = 1
+                posm[i] = self.pos[i]
+        planned: list[tuple[int, int]] = []  # (slot, n) with n >= 1
+        total_new = 0
+        for i, n in zip(seats, ns):
+            if n <= 0:
+                continue  # budget exhausted: the seat idles (trash)
+            seat = self.slots[i]["prefill"]
+            posm[i] = seat.pos
+            keep_from[i] = seat.keep_from
+            chunk = seat.take(n)
+            ids_np[i, :n] = chunk
+            n_keep[i] = n
+            emit_idx[i] = n - 1
+            planned.append((i, n))
+            total_new += n
+        # Decode rows' input token comes from the persistent device
+        # feed — merged on device so the host never syncs on it.
+        dec_mask = jnp.asarray(decode_live)[:, None]
+        ids = jnp.asarray(ids_np)
+        ids = ids.at[:, :1].set(
+            jnp.where(dec_mask, self._feed, ids[:, :1])
+        )
+        logits, self.pool_k, self.pool_v = mt(
+            self.params,
+            self.pool_k,
+            self.pool_v,
+            jnp.asarray(self.tables.copy()),
+            jnp.asarray(posm),
+            ids,
+            jnp.asarray(n_keep),
+            jnp.asarray(keep_from),
+            jnp.asarray(self.adapter.copy()),
+        )
+        self.ticks += 1
+        self.dispatches += 1
+        self.mixed_ticks_n += 1
+        n_live = sum(decode_live)
+        now = time.perf_counter()
+        if self._last_tick_t is not None and n_live:
+            self.obs.itl.observe(now - self._last_tick_t, n_live)
+        self._last_tick_t = now
+        self.obs.ticks.inc()
+        self.obs.host_dispatches.inc()
+        self.obs.mixed_prefill_tokens.inc(total_new)
+        self.mixed_prefill_tokens_n += total_new
+        self._update_stall_fraction()
+        self._account_psums(1)
+        self._account_kv_rows_mixed(posm, T)
+        # Per-row emit position: 0 for decode rows, the chunk's last
+        # real token for seats (only consumed when the seat flips).
+        ll = jnp.take_along_axis(
+            logits, jnp.asarray(emit_idx)[:, None, None], axis=1
+        )[:, 0, :]
+        ll_raw = ll  # pre-constraint rows, for seat flips
+        sm = self._sampler
+        constrained = any(sm.row_constrained)
+        if constrained:
+            crow, cacc = crt.constrain_rows(
+                self._ctrans, self._cacc, sm.cid, sm.cstate
+            )
+            cmask = crt.constrain_mask(crow, cacc, self.eos_id)
+            cvec = jnp.asarray(sm.row_constrained)
+            dead = cvec & jnp.asarray(decode_live) & ~cmask.any(-1)
+            ll = crt.fold_mask(ll, cmask)
+        # Seat rows never steer the draw-vs-argmax choice: their
+        # sampler rows install at flip (admit_first reseeds), so the
+        # key stream matches the stall path draw for draw.
+        if any(
+            s is not None and "prefill" not in s and s["sampling"]
+            for s in self.slots
+        ):
+            nxt = self._sampler.draw(ll)
+        else:
+            nxt = jnp.argmax(ll, axis=-1)
+        if constrained:
+            nxt = jnp.where(dead, self.eos_id, nxt)
+            sm.cstate = crt.advance_state(
+                crow, sm.cstate, nxt, cvec & ~dead
+            )
+            mfrac = crt.masked_frac(
+                cmask, cvec & jnp.asarray(decode_live)
+            )
+        self._feed = nxt[:, None].astype(jnp.int32)
+        need_host = (
+            self.eos_id is not None
+            or self.on_token is not None
+            or any(
+                s is not None and s.get("stop") is not None
+                for s in self.slots
+            )
+        )
+        # analysis: ignore[host-sync-in-hot-loop] single batched
+        # transfer per mixed tick, and only when an eos/stop/stream
+        # consumer needs host tokens — same guard as every tick path
+        host_nxt = np.asarray(nxt) if need_host else None
+        if constrained:
+            # analysis: ignore[host-sync-in-hot-loop] one batched
+            # per-tick transfer of the dead-end flags + mask
+            # fractions, only while a constrained row is live
+            dead_host = np.asarray(dead)
+            # analysis: ignore[host-sync-in-hot-loop] ready with the
+            # vector above (same sync point)
+            mfrac_host = np.asarray(mfrac)
+        accepted = 0
+        for i, slot in enumerate(self.slots):
+            if slot is None or not decode_live[i]:
+                continue
+            if constrained and slot["cid"]:
+                if bool(dead_host[i]):
+                    self.errors[slot["rid"]] = (
+                        "constraint dead end: DFA state admits no "
+                        "token and is not accepting"
+                    )
+                    self.constraint_dead_ends_n += 1
+                    self.obs.constrain_dead_ends.inc()
+                    slot["remaining"] = 0
+                    self._finish(i)
+                    continue
+                self.constrained_tokens_n += 1
+                self.obs.constrained_tokens.inc()
+                self.obs.constrain_masked_frac.observe(
+                    float(mfrac_host[i])
+                )
+            tok = nxt[i][None, None].astype(slot["last"].dtype)
+            slot["last"] = tok
+            slot["toks"].append(tok)
+            slot["remaining"] -= 1
+            self.pos[i] += 1
+            accepted += 1
+            self._emit_token(
+                i,
+                slot,
+                int(host_nxt[i]) if host_nxt is not None else None,
+            )
+        # Seats advance AFTER the decode drain: pos moves chunk by
+        # chunk, and the seat whose last chunk just landed flips to
+        # decoding this very tick.
+        for i, n in planned:
+            slot = self.slots[i]
+            seat = slot["prefill"]
+            self.pos[i] = seat.pos
+            if seat.finished:
+                self._flip_seat(i, slot, ll_raw[i : i + 1])
+                accepted += 1
+        self.obs.tokens_per_dispatch.set(float(accepted))
+        self.window_tokens += accepted
+
     def _tick(self) -> None:
         if self.pp > 1:
             return self._tick_pp()
@@ -4322,6 +4900,12 @@ class PagedDecodeServer:
             if self.decode_window > 1:
                 return self._tick_spec_window()
             return self._tick_spec()
+        if self._seat_slots():
+            # Mixed mode engages only while a seat is mid-prefill;
+            # pure-decode stretches fall through to the EXACT plain /
+            # window programs (the prefill_budget=None bit-identity
+            # contract, and the window scan's dispatch amortization).
+            return self._tick_mixed()
         if self.decode_window > 1:
             return self._tick_window()
         live = [s is not None for s in self.slots]
@@ -4358,6 +4942,10 @@ class PagedDecodeServer:
         self._last_tick_t = now
         self.obs.ticks.inc()
         self.obs.host_dispatches.inc()
+        # Every decode tick moves the stall fraction's denominator —
+        # republished here so the gauge decays as decode resumes (the
+        # [contract.mixed] budget gate reads it).
+        self._update_stall_fraction()
         self._account_psums(1)
         self.obs.tokens_per_dispatch.set(float(n_live))
         self.window_tokens += n_live
@@ -5167,6 +5755,7 @@ class PagedDecodeServer:
         self._last_tick_t = now
         self.obs.ticks.inc()
         self.obs.host_dispatches.inc()
+        self._update_stall_fraction()
         # The fused window scans K sub-steps inside ONE sharded
         # program: K forwards' worth of collectives per dispatch.
         self._account_psums(K)
@@ -5663,6 +6252,8 @@ def serve_paged(
     spec_params: dict | None = None,
     spec_k: int = 0,
     prefill_chunk: int | None = None,
+    prefill_budget: int | None = None,
+    prefill_lookahead: int = 2,
     mesh: Any = None,
     model_axis: str = "model",
     constraints: dict | None = None,
@@ -5693,6 +6284,14 @@ def serve_paged(
     `spec_rounds` / `spec_proposed` / `spec_accepted` /
     `spec_acceptance` / `spec_draft_tokens`. `prefill_chunk=C`
     switches admission to the pool-native chunked prefill path.
+
+    `prefill_budget=N` turns on STALL-FREE continuous batching
+    (PagedDecodeServer docstring): admission prefill rides inside the
+    decode dispatches, up to N prompt tokens per tick, token-identical
+    greedy output to the default None. Stats always carry
+    `prefill_budget`, `prefill_stall_ticks` (serialized-prefill
+    dispatches issued while decode slots waited), `mixed_ticks`,
+    `mixed_prefill_tokens`, and `decode_stall_fraction`.
 
     `mesh=` / `model_axis=` run the server tensor-parallel: weights
     and the KV block pool shard over the named mesh axis and every
@@ -5735,6 +6334,8 @@ def serve_paged(
         spec_params=spec_params,
         spec_k=spec_k,
         prefill_chunk=prefill_chunk,
+        prefill_budget=prefill_budget,
+        prefill_lookahead=prefill_lookahead,
         mesh=mesh,
         model_axis=model_axis,
         constraints=constraints,
@@ -5797,6 +6398,11 @@ def serve_paged(
         ),
         spec_draft_tokens=srv.spec_draft_tokens_n,
         prefill_chunk=srv.prefill_chunk,
+        prefill_budget=srv.prefill_budget,
+        prefill_stall_ticks=srv.prefill_stall_ticks_n,
+        mixed_ticks=srv.mixed_ticks_n,
+        mixed_prefill_tokens=srv.mixed_prefill_tokens_n,
+        decode_stall_fraction=srv.decode_stall_fraction_last,
         mesh_shape=srv.mesh_label,
         tp_psums=srv.tp_psums,
         kv_dtype=srv.kv_dtype,

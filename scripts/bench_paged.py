@@ -22,6 +22,12 @@ Also here: `run_window_sweep(devices) -> dict` (`--window-sweep` on
 the CLI) — the fused-decode-window sweep (decode_window = K in
 {1,4,8,16}) pricing host dispatches per token against tokens/sec;
 bench.py runs it as the "decode_window" extras section. And
+`run_mixed_sweep(devices) -> dict` (`--mixed-sweep`) — the
+mixed-mode continuous-batching sweep (prefill_budget = stall
+baseline + {64,128,256,inf}, the same request mix offered open-loop
+via runtime/batching.py::poisson_arrivals) pricing live slots' ITL
+p50/p99, TTFT, tokens/sec and the decode-stall fraction per budget;
+bench.py runs it as the "mixed_serving" extras section. And
 `run_spec_sweep(devices) -> dict` (`--spec-sweep`) — the paged
 speculative-decoding sweep (spec_k in {0,2,4} crossed with a DRAFT
 AXIS: self | trunc:L/2 | trunc:L/4 | width:1/2, built with
@@ -297,6 +303,181 @@ def run_window_sweep(
             ),
             "speedup_vs_k1": round(tps / base_tps, 3),
         }
+    return out
+
+
+def run_mixed_sweep(
+    devices=None,
+    *,
+    budgets: tuple = (64, 128, 256, "inf"),
+    arrival_rate: float = 16.0,
+    arrival_seed: int = 0,
+    num_layers: int = 4,
+    dim: int = 256,
+    num_heads: int = 8,
+    num_kv_heads: int = 4,
+    vocab_size: int = 2048,
+    max_len: int = 512,
+    num_blocks: int = 49,
+    block_size: int = 16,
+    max_batch: int = 4,
+    num_requests: int = 12,
+) -> dict:
+    """Mixed-mode continuous-batching sweep: the same request mix
+    offered OPEN-LOOP (runtime/batching.py::poisson_arrivals — a fixed
+    seeded arrival trace that does not throttle itself when the server
+    falls behind), served with prefill_budget = None (the stall
+    baseline: every admission prefill preempts decode) and each value
+    in `budgets` ("inf" = effectively unbounded). Returns {config,
+    budgets: {stall|64|...|inf: {itl_p50_ms, itl_p99_ms, ttft_mean_ms,
+    ttft_p95_ms, tokens_per_sec, prefill_stall_ticks, mixed_ticks,
+    mixed_prefill_tokens, decode_stall_fraction}}}.
+
+    The point being measured: with stall-mode admission, a prompt
+    arriving mid-decode freezes every live slot for its whole prefill
+    — the freeze lands directly in the live slots' inter-token
+    latency tail (ITL p99). Mixed mode fuses up to `budget` prompt
+    tokens into each decode dispatch, so decode never skips a tick
+    and the p99 collapses toward the p50; the budget knob then trades
+    TTFT (bigger chunks land prompts sooner) against per-tick decode
+    latency (wider fused T costs more per dispatch)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from defer_tpu.models.gpt import GptDecoder
+    from defer_tpu.models.llama import llama_config
+    from defer_tpu.runtime.batching import poisson_arrivals
+    from defer_tpu.runtime.paged import PagedDecodeServer
+
+    cfg = llama_config(
+        num_layers=num_layers,
+        dim=dim,
+        num_heads=num_heads,
+        num_kv_heads=num_kv_heads,
+        ffn_dim=dim * 2,
+        vocab_size=vocab_size,
+        max_len=max_len,
+    )
+    dec = GptDecoder(cfg, compute_dtype=jnp.bfloat16)
+    params = dec.cast_params(dec.init(jax.random.key(0)))
+    if devices:
+        params = jax.device_put(params, devices[0])
+    reqs = []
+    for i in range(num_requests):
+        t0 = 16 + (i * 23) % 112
+        steps = 16 + (i * 11) % 48
+        prompt = jax.random.randint(
+            jax.random.fold_in(jax.random.key(1), i),
+            (1, t0),
+            0,
+            cfg.vocab_size,
+        )
+        reqs.append((prompt, steps))
+    total_tokens = sum(s for _, s in reqs)
+    arrivals = poisson_arrivals(
+        num_requests, arrival_rate, seed=arrival_seed
+    )
+
+    def run_point(budget):
+        stamps: dict = {}
+
+        def on_token(rid, tok, done):
+            stamps.setdefault(rid, []).append(time.perf_counter())
+
+        srv = PagedDecodeServer(
+            dec,
+            params,
+            num_blocks=num_blocks,
+            block_size=block_size,
+            max_batch=max_batch,
+            prefill_budget=budget,
+            on_token=on_token,
+        )
+        submit_at: dict = {}
+        nxt = 0
+        t0 = time.perf_counter()
+        while nxt < len(reqs) or srv.pending or any(
+            s is not None for s in srv.slots
+        ):
+            now = time.perf_counter() - t0
+            while nxt < len(reqs) and arrivals[nxt] <= now:
+                rid = srv.submit(*reqs[nxt])
+                submit_at[rid] = time.perf_counter()
+                nxt += 1
+            srv._admit()
+            if any(s is not None for s in srv.slots):
+                srv._tick()
+            elif nxt < len(reqs):
+                # Open-loop idle gap: nothing seated, next arrival
+                # still in the future — sleep toward it instead of
+                # spinning admit hot.
+                time.sleep(
+                    min(
+                        5e-4,
+                        max(
+                            0.0,
+                            arrivals[nxt]
+                            - (time.perf_counter() - t0),
+                        ),
+                    )
+                )
+        dt = time.perf_counter() - t0
+        gaps = [
+            g
+            for ts in stamps.values()
+            for g in np.diff(ts)
+            if len(ts) >= 2
+        ]
+        ttfts = [
+            ts[0] - submit_at[rid] for rid, ts in stamps.items()
+        ]
+        return {
+            "itl_p50_ms": round(
+                float(np.percentile(gaps, 50)) * 1e3, 3
+            ),
+            "itl_p99_ms": round(
+                float(np.percentile(gaps, 99)) * 1e3, 3
+            ),
+            "ttft_mean_ms": round(
+                float(np.mean(ttfts)) * 1e3, 3
+            ),
+            "ttft_p95_ms": round(
+                float(np.percentile(ttfts, 95)) * 1e3, 3
+            ),
+            "tokens_per_sec": round(total_tokens / dt, 1),
+            "prefill_stall_ticks": srv.prefill_stall_ticks_n,
+            "mixed_ticks": srv.mixed_ticks_n,
+            "mixed_prefill_tokens": srv.mixed_prefill_tokens_n,
+            "decode_stall_fraction": round(
+                srv.decode_stall_fraction_last, 4
+            ),
+        }
+
+    out: dict = {
+        "config": {
+            "num_layers": num_layers,
+            "dim": dim,
+            "heads": f"{num_heads}/{num_kv_heads}kv",
+            "max_len": max_len,
+            "num_blocks": num_blocks,
+            "block_size": block_size,
+            "max_batch": max_batch,
+            "requests": num_requests,
+            "total_tokens": total_tokens,
+            "arrival_rate_rps": arrival_rate,
+            "arrival_seed": arrival_seed,
+        },
+        "budgets": {},
+    }
+    # "inf" = a budget no single tick can exhaust: admission-window
+    # prompts land as fast as chunk_cap/t_limit allow.
+    points = [("stall", None)] + [
+        (str(b), max_len if b == "inf" else int(b)) for b in budgets
+    ]
+    for key, budget in points:
+        run_point(budget)  # compile pass
+        out["budgets"][key] = run_point(budget)
     return out
 
 
@@ -1184,6 +1365,28 @@ def main() -> None:
         help="comma-separated decode_window values for --window-sweep",
     )
     ap.add_argument(
+        "--mixed-sweep",
+        action="store_true",
+        help="run the mixed-mode continuous-batching sweep "
+        "(prefill_budget = stall baseline + --mixed-budgets, "
+        "open-loop Poisson arrivals) instead of the attention "
+        "microbench",
+    )
+    ap.add_argument(
+        "--mixed-budgets",
+        default="64,128,256,inf",
+        help="comma-separated prefill_budget values for "
+        "--mixed-sweep (inf = unbounded; the stall baseline is "
+        "always included)",
+    )
+    ap.add_argument(
+        "--mixed-rate",
+        type=float,
+        default=16.0,
+        help="open-loop arrival rate (requests/sec) for "
+        "--mixed-sweep",
+    )
+    ap.add_argument(
         "--spec-sweep",
         action="store_true",
         help="run the paged speculative-decoding sweep (spec_k = "
@@ -1420,6 +1623,15 @@ def main() -> None:
             drafts=drafts,
             decode_window=args.spec_window,
             **shared,
+        )
+    elif args.mixed_sweep:
+        budgets = tuple(
+            b if b == "inf" else int(b)
+            for b in args.mixed_budgets.split(",")
+            if b
+        )
+        rec = run_mixed_sweep(
+            budgets=budgets, arrival_rate=args.mixed_rate, **shared
         )
     elif args.window_sweep:
         windows = tuple(

@@ -1,0 +1,28 @@
+"""NEGATIVE: the fused mixed-mode tick — decode rows plus up to
+`budget` prompt tokens ride ONE jitted dispatch, planned entirely from
+host-side seat bookkeeping (numpy ints, no device values), so the tick
+issues no prefill-side syncs at all and decode never skips a tick."""
+
+
+class Server:
+    def _tick(self):
+        # Host-side plan over host-side seat state: which rows are
+        # decode, which carry prompt chunks, and the fused width T.
+        t, ns = self._plan(self.budget)
+        ids, n_keep, keep_from = self._pack(t, ns)
+        # One fused dispatch carries decode AND prefill rows; the
+        # result stays on device (sampling feeds the next tick's
+        # persistent feed buffer by device-side update).
+        logits, self.cache = self.step(
+            self.params, self.cache, ids, n_keep, keep_from
+        )
+        self._feed = self._advance(logits)
+
+    def _plan(self, budget):
+        ns = []
+        left = budget
+        for seat in self.seats:
+            n = min(seat.remaining, left)
+            ns.append(n)
+            left -= n
+        return max(ns, default=0), ns

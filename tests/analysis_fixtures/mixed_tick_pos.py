@@ -1,0 +1,23 @@
+"""POSITIVE: stall-mode admission prefill inside the serving tick —
+the prompt runs to completion in its own per-chunk dispatch loop with
+a host sync per chunk, so every live decode slot stalls behind
+len(chunks) round trips before the tick's decode dispatch even
+starts."""
+
+import numpy as np
+
+
+class Server:
+    def _tick(self):
+        # Admission-prefill-in-the-tick: each seated prompt is run to
+        # completion HERE, serially, before decode advances.
+        for seat in self._seats():
+            for chunk in self._chunks(seat):
+                logits = self.step(self.params, chunk)
+                # Per-chunk device->host pull to decide the next
+                # chunk's offset — one sync per chunk per prompt.
+                seat.pos += int(np.asarray(logits.shape_info)[0])
+        feed = self._decode_feed()
+        out = self.step(self.params, feed)
+        # Per-tick scalar pull on the decode result.
+        self.last = out[0, 0].item()

@@ -34,6 +34,7 @@ CASES = [
     ("host-sync-in-hot-loop", "shard_map", 2),
     ("host-sync-in-hot-loop", "kv_spill", 2),
     ("host-sync-in-hot-loop", "constrain", 2),
+    ("host-sync-in-hot-loop", "mixed_tick", 2),
     ("fresh-closure-jit", "fresh_closure", 2),
     ("prng-key-reuse", "prng_reuse", 1),
     ("lock-discipline", "lock_discipline", 2),
@@ -271,6 +272,7 @@ def test_repo_budget_gate_and_suppression_ledger(capsys):
     assert set(verdicts) == {
         "dispatches_per_token_w8",
         "kv_rows_per_shard_tp2",
+        "mixed",
         "pp",
         "window_drain_b_k",
     }
